@@ -1,0 +1,16 @@
+"""dispatches_tpu — TPU-native hybrid-energy design & dispatch optimization.
+
+A ground-up JAX/XLA re-design of the capabilities of GMLC DISPATCHES
+(https://github.com/gmlc-dispatches/dispatches): hybrid energy plants are
+modeled as parametric LPs/NLPs lowered once to device tensors, solved by
+batched differentiable interior-point kernels vmapped over market scenarios,
+with Flax-based market surrogates, double-loop market co-simulation adapters,
+and techno-economic analysis sharing one device graph. See SURVEY.md for the
+reference layer map and PARITY.md for the component-by-component mapping.
+"""
+
+__version__ = "0.1.0"
+
+from .core.model import Model, INF
+from .core.program import CompiledLP, LPData
+from .solvers.ipm import solve_lp, solve_lp_batch, IPMSolution

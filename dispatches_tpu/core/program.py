@@ -1,0 +1,225 @@
+"""CompiledLP: lowered parametric LP + device instantiation.
+
+Replaces the reference's Pyomo → AMPL `.nl` file → solver-subprocess bridge
+(SURVEY.md §2.6 "AMPL .nl writer / ASL") with direct parametric extraction:
+model → static index arrays at build time → ``instantiate(params)`` produces
+standard-form LP tensors ``min c.x s.t. A x = b, l <= x <= u`` on device with
+pure gather/scatter ops, jit- and vmap-compatible over a scenario batch axis.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .expr import Expr, _ConstBlock, _TermBlock
+
+
+class LPData(NamedTuple):
+    """Standard-form LP on device: min c.x + c0  s.t.  A x = b, l <= x <= u."""
+
+    A: jnp.ndarray  # (M, N)
+    b: jnp.ndarray  # (M,)
+    c: jnp.ndarray  # (N,)
+    l: jnp.ndarray  # (N,)
+    u: jnp.ndarray  # (N,)
+    c0: jnp.ndarray  # ()
+
+
+@dataclasses.dataclass
+class _ParamGroup:
+    rows: np.ndarray
+    cols: Optional[np.ndarray]  # None for rhs/c0 contributions
+    scale: np.ndarray
+    pidx: np.ndarray
+
+
+def _collect(exprs: List[Expr], row_offsets: List[int]):
+    """Concatenate term/const blocks of a list of expressions with row offsets."""
+    t_rows, t_cols, t_scale = [], [], []
+    t_param: Dict[str, List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]] = {}
+    c_rows, c_scale = [], []
+    c_param: Dict[str, List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = {}
+    for e, off in zip(exprs, row_offsets):
+        for b in e.terms:
+            rows = b.rows.astype(np.int64) + off
+            if b.pname is None:
+                t_rows.append(rows)
+                t_cols.append(b.cols)
+                t_scale.append(b.scale)
+            else:
+                t_param.setdefault(b.pname, []).append((rows, b.cols, b.scale, b.pidx))
+        for b in e.consts:
+            rows = b.rows.astype(np.int64) + off
+            if b.pname is None:
+                c_rows.append(rows)
+                c_scale.append(b.scale)
+            else:
+                c_param.setdefault(b.pname, []).append((rows, b.scale, b.pidx))
+
+    def cat(lst, dtype=None):
+        if not lst:
+            return np.zeros(0, dtype=dtype or np.float64)
+        return np.concatenate(lst)
+
+    t = (cat(t_rows, np.int64), cat(t_cols, np.int64), cat(t_scale))
+    c = (cat(c_rows, np.int64), cat(c_scale))
+    tp = {
+        k: (
+            np.concatenate([x[0] for x in v]),
+            np.concatenate([x[1] for x in v]),
+            np.concatenate([x[2] for x in v]),
+            np.concatenate([x[3] for x in v]),
+        )
+        for k, v in t_param.items()
+    }
+    cp = {
+        k: (
+            np.concatenate([x[0] for x in v]),
+            np.concatenate([x[1] for x in v]),
+            np.concatenate([x[2] for x in v]),
+        )
+        for k, v in c_param.items()
+    }
+    return t, tp, c, cp
+
+
+class CompiledLP:
+    """A parametric LP lowered from a `Model`. Immutable after construction."""
+
+    def __init__(self):
+        raise TypeError("use Model.build()")
+
+    @classmethod
+    def _from_model(cls, m) -> "CompiledLP":
+        self = object.__new__(cls)
+        self.name = m.name
+        self.param_shapes = {k: p.shape for k, p in m._params.items()}
+        self._vars = dict(m._vars)
+
+        n = m._nvars
+        Me = sum(e.R for e in m._eq)
+        Mi = sum(e.R for e in m._le)
+        self.n_orig = n
+        self.n_slack = Mi
+        self.M = Me + Mi
+        self.N = n + Mi
+
+        # row offsets: eq rows first, then le rows (each le row gets one slack)
+        eq_offs, off = [], 0
+        for e in m._eq:
+            eq_offs.append(off)
+            off += e.R
+        le_offs = []
+        for e in m._le:
+            le_offs.append(off)
+            off += e.R
+
+        (t, tp, c, cp) = _collect(m._eq + m._le, eq_offs + le_offs)
+        # slack identity entries on le rows
+        slack_rows = np.arange(Me, Me + Mi, dtype=np.int64)
+        slack_cols = np.arange(n, n + Mi, dtype=np.int64)
+        self.A_rows = np.concatenate([t[0], slack_rows])
+        self.A_cols = np.concatenate([t[1], slack_cols])
+        self.A_vals = np.concatenate([t[2], np.ones(Mi)])
+        self.A_pgroups = tp  # name -> (rows, cols, scale, pidx)
+        # rhs: A x (+ s) = -const
+        self.b_rows = c[0]
+        self.b_vals = -c[1]
+        self.b_pgroups = {k: (v[0], -v[1], v[2]) for k, v in cp.items()}
+
+        # objective
+        sense = m._obj_sense
+        if m._obj is None:
+            ot = ((np.zeros(0, np.int64),) * 2 + (np.zeros(0),), {}, (np.zeros(0, np.int64), np.zeros(0)), {})
+        else:
+            ot = _collect([m._obj], [0])
+        (tt, ttp, tc, tcp) = ot
+        self.c_cols = tt[1]
+        self.c_vals = sense * tt[2]
+        self.c_pgroups = {k: (v[1], sense * v[2], v[3]) for k, v in ttp.items()}
+        self.c0_val = float(sense * tc[1].sum()) if tc[1].size else 0.0
+        self.c0_pgroups = {k: (sense * v[1], v[2]) for k, v in tcp.items()}
+        self.obj_sense = sense
+
+        # bounds
+        lb = np.zeros(self.N)
+        ub = np.full(self.N, np.inf)
+        for vm in self._vars.values():
+            lb[vm.start : vm.start + vm.size] = vm.lb
+            ub[vm.start : vm.start + vm.size] = vm.ub
+        # slacks: [0, inf)
+        self.lb = lb
+        self.ub = ub
+
+        # named expressions for post-solve evaluation
+        self._exprs = {}
+        for name, e in getattr(m, "_exprs", {}).items():
+            self._exprs[name] = _collect([e], [0]) + (e.R,)
+
+        self.has_param_A = bool(self.A_pgroups)
+        return self
+
+    # ------------------------------------------------------------------
+    def instantiate(self, params: Dict[str, jnp.ndarray], dtype=None) -> LPData:
+        """Build LP tensors from parameter values. jit/vmap-compatible."""
+        for k, shp in self.param_shapes.items():
+            if k not in params:
+                raise KeyError(f"missing param '{k}'")
+        dtype = dtype or jnp.result_type(float)
+        A = jnp.zeros((self.M, self.N), dtype=dtype)
+        A = A.at[self.A_rows, self.A_cols].add(jnp.asarray(self.A_vals, dtype))
+        for k, (rows, cols, scale, pidx) in self.A_pgroups.items():
+            vals = jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx]
+            A = A.at[rows, cols].add(vals)
+        b = jnp.zeros((self.M,), dtype=dtype)
+        b = b.at[self.b_rows].add(jnp.asarray(self.b_vals, dtype))
+        for k, (rows, scale, pidx) in self.b_pgroups.items():
+            b = b.at[rows].add(
+                jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx]
+            )
+        c = jnp.zeros((self.N,), dtype=dtype)
+        c = c.at[self.c_cols].add(jnp.asarray(self.c_vals, dtype))
+        for k, (cols, scale, pidx) in self.c_pgroups.items():
+            c = c.at[cols].add(
+                jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx]
+            )
+        c0 = jnp.asarray(self.c0_val, dtype)
+        for k, (scale, pidx) in self.c0_pgroups.items():
+            c0 = c0 + jnp.sum(
+                jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx]
+            )
+        return LPData(
+            A=A,
+            b=b,
+            c=c,
+            l=jnp.asarray(self.lb, dtype),
+            u=jnp.asarray(self.ub, dtype),
+            c0=c0,
+        )
+
+    # ------------------------------------------------------------------
+    def extract(self, name: str, x: jnp.ndarray) -> jnp.ndarray:
+        """Pull a named variable's values out of a solution vector (batched ok)."""
+        vm = self._vars[name]
+        sl = x[..., vm.start : vm.start + vm.size]
+        return sl.reshape(x.shape[:-1] + vm.shape) if vm.shape else sl[..., 0]
+
+    def eval_expr(self, name: str, x: jnp.ndarray, params: Dict[str, jnp.ndarray]):
+        """Evaluate a named affine expression at solution x (Pyomo Expression
+        analogue, e.g. NPV/revenue reporting in `wind_battery_LMP.py:253-263`)."""
+        (t, tp, cst, cp, R) = self._exprs[name]
+        dtype = x.dtype
+        out = jnp.zeros(x.shape[:-1] + (R,), dtype=dtype)
+        out = out.at[..., t[0]].add(jnp.asarray(t[2], dtype) * x[..., t[1]])
+        for k, (rows, cols, scale, pidx) in tp.items():
+            pv = jnp.ravel(params[k]).astype(dtype)[pidx]
+            out = out.at[..., rows].add(jnp.asarray(scale, dtype) * pv * x[..., cols])
+        out = out.at[..., cst[0]].add(jnp.asarray(cst[1], dtype))
+        for k, (rows, scale, pidx) in cp.items():
+            pv = jnp.ravel(params[k]).astype(dtype)[pidx]
+            out = out.at[..., rows].add(jnp.asarray(scale, dtype) * pv)
+        return out[..., 0] if R == 1 else out

@@ -1,0 +1,228 @@
+"""Batched dense interior-point LP solver (Mehrotra predictor-corrector).
+
+TPU-native replacement for the reference's CBC/IPOPT subprocess solves
+(`wind_battery_LMP.py:266-267`, SURVEY.md §2.6): one jit-compiled solve,
+vmappable over a scenario batch axis, running entirely on device. The KKT
+system is reduced to regularized normal equations ``(A W A^T + δI) Δy = r``
+solved by dense Cholesky — MXU-friendly, with optional iterative refinement so
+float32 on TPU reaches the reference's result tolerances (rel 1e-3 on NPV).
+
+Standard form: min c.x  s.t.  A x = b,  l <= x <= u  (bounds may be ±inf).
+
+The optimal-value gradient w.r.t. parameters is exposed via the envelope
+theorem in `dispatches_tpu/solvers/diff.py` rather than by differentiating
+through the iteration loop.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.program import LPData
+
+
+class IPMSolution(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray  # equality duals
+    zl: jnp.ndarray  # lower-bound duals (0 where bound infinite)
+    zu: jnp.ndarray  # upper-bound duals
+    obj: jnp.ndarray  # c.x + c0
+    converged: jnp.ndarray  # bool
+    iterations: jnp.ndarray
+    res_primal: jnp.ndarray
+    res_dual: jnp.ndarray
+    gap: jnp.ndarray
+
+
+def _max_step(v, dv, mask):
+    """Largest alpha in (0, 1] with v + alpha*dv >= 0 over masked entries."""
+    neg = (dv < 0) & mask
+    ratios = jnp.where(neg, -v / jnp.where(neg, dv, -1.0), jnp.inf)
+    return jnp.minimum(1.0, jnp.min(ratios, initial=jnp.inf))
+
+
+@partial(jax.jit, static_argnames=("max_iter", "refine_steps"))
+def solve_lp(
+    lp: LPData,
+    tol: float = 1e-8,
+    max_iter: int = 60,
+    reg_p: float = 1e-9,
+    reg_d: float = 1e-9,
+    refine_steps: int = 1,
+) -> IPMSolution:
+    A, b, c, l, u, c0 = lp
+    dtype = A.dtype
+    M, N = A.shape
+    fl = jnp.isfinite(l)
+    fu = jnp.isfinite(u)
+    nlu = jnp.maximum(1.0, (fl.sum() + fu.sum()).astype(dtype))
+    l_s = jnp.where(fl, l, 0.0)
+    u_s = jnp.where(fu, u, 0.0)
+
+    bnorm = 1.0 + jnp.linalg.norm(b)
+    cnorm = 1.0 + jnp.linalg.norm(c)
+
+    # -- starting point ------------------------------------------------
+    both = fl & fu
+    x0 = jnp.where(
+        both,
+        0.5 * (l_s + u_s),
+        jnp.where(fl, l_s + 1.0, jnp.where(fu, u_s - 1.0, 0.0)),
+    )
+    # keep strictly interior for two-sided narrow boxes
+    x0 = jnp.where(both & (u_s - l_s < 2e-8), 0.5 * (l_s + u_s), x0)
+    y0 = jnp.zeros((M,), dtype)
+    z0l = jnp.where(fl, 1.0, 0.0).astype(dtype)
+    z0u = jnp.where(fu, 1.0, 0.0).astype(dtype)
+
+    def residuals(x, y, zl, zu):
+        rp = b - A @ x
+        rd = c - A.T @ y - zl + zu
+        xl = jnp.where(fl, x - l_s, 1.0)
+        xu = jnp.where(fu, u_s - x, 1.0)
+        comp = jnp.sum(jnp.where(fl, xl * zl, 0.0)) + jnp.sum(
+            jnp.where(fu, xu * zu, 0.0)
+        )
+        return rp, rd, comp
+
+    def cond(state):
+        x, y, zl, zu, it, done = state
+        return (it < max_iter) & (~done)
+
+    def body(state):
+        x, y, zl, zu, it, _ = state
+        xl = jnp.where(fl, x - l_s, 1.0)
+        xu = jnp.where(fu, u_s - x, 1.0)
+        zl_s = jnp.where(fl, zl, 0.0)
+        zu_s = jnp.where(fu, zu, 0.0)
+        rp = b - A @ x
+        rd = c - A.T @ y - zl_s + zu_s
+        mu = (
+            jnp.sum(jnp.where(fl, xl * zl, 0.0))
+            + jnp.sum(jnp.where(fu, xu * zu, 0.0))
+        ) / nlu
+
+        d = (
+            jnp.where(fl, zl / xl, 0.0)
+            + jnp.where(fu, zu / xu, 0.0)
+            + jnp.asarray(reg_p, dtype)
+        )
+        w = 1.0 / d
+        K = (A * w[None, :]) @ A.T
+        K = K + jnp.asarray(reg_d, dtype) * (1.0 + jnp.diagonal(K).max()) * jnp.eye(
+            M, dtype=dtype
+        )
+        cf = jax.scipy.linalg.cho_factor(K)
+
+        def kkt_solve(rcl, rcu):
+            rhat = rd - jnp.where(fl, rcl / xl, 0.0) + jnp.where(fu, rcu / xu, 0.0)
+            rhs = rp + A @ (w * rhat)
+            dy = jax.scipy.linalg.cho_solve(cf, rhs)
+            for _ in range(refine_steps):
+                resid = rhs - K @ dy
+                dy = dy + jax.scipy.linalg.cho_solve(cf, resid)
+            dx = w * (A.T @ dy - rhat)
+            dzl = jnp.where(fl, (rcl - zl_s * dx) / xl, 0.0)
+            dzu = jnp.where(fu, (rcu + zu_s * dx) / xu, 0.0)
+            return dx, dy, dzl, dzu
+
+        # predictor (affine scaling)
+        rcl_a = jnp.where(fl, -xl * zl, 0.0)
+        rcu_a = jnp.where(fu, -xu * zu, 0.0)
+        dx_a, dy_a, dzl_a, dzu_a = kkt_solve(rcl_a, rcu_a)
+        ap = jnp.minimum(_max_step(xl, dx_a, fl), _max_step(xu, -dx_a, fu))
+        ad = jnp.minimum(_max_step(zl, dzl_a, fl), _max_step(zu, dzu_a, fu))
+        mu_aff = (
+            jnp.sum(jnp.where(fl, (xl + ap * dx_a) * (zl + ad * dzl_a), 0.0))
+            + jnp.sum(jnp.where(fu, (xu - ap * dx_a) * (zu + ad * dzu_a), 0.0))
+        ) / nlu
+        sigma = jnp.clip((mu_aff / (mu + 1e-300)) ** 3, 0.0, 1.0)
+
+        # corrector
+        rcl = jnp.where(fl, sigma * mu - xl * zl - dx_a * dzl_a, 0.0)
+        rcu = jnp.where(fu, sigma * mu - xu * zu + dx_a * dzu_a, 0.0)
+        dx, dy, dzl, dzu = kkt_solve(rcl, rcu)
+
+        frac = jnp.asarray(0.9995, dtype)
+        ap = frac * jnp.minimum(_max_step(xl, dx, fl), _max_step(xu, -dx, fu))
+        ad = frac * jnp.minimum(_max_step(zl, dzl, fl), _max_step(zu, dzu, fu))
+
+        x_n = x + ap * dx
+        y_n = y + ad * dy
+        zl_n = jnp.where(fl, zl + ad * dzl, 0.0)
+        zu_n = jnp.where(fu, zu + ad * dzu, 0.0)
+
+        # numerical-breakdown guard: as mu -> 0 the normal equations go
+        # singular; if the step produced nonfinite values, keep the previous
+        # (already near-optimal) iterate and stop.
+        ok = (
+            jnp.all(jnp.isfinite(x_n))
+            & jnp.all(jnp.isfinite(y_n))
+            & jnp.all(jnp.isfinite(zl_n))
+            & jnp.all(jnp.isfinite(zu_n))
+        )
+        x_n = jnp.where(ok, x_n, x)
+        y_n = jnp.where(ok, y_n, y)
+        zl_n = jnp.where(ok, zl_n, zl)
+        zu_n = jnp.where(ok, zu_n, zu)
+
+        rp_n, rd_n, comp_n = residuals(x_n, y_n, zl_n, zu_n)
+        objmag = 1.0 + jnp.abs(c @ x_n)
+        done = (
+            (jnp.linalg.norm(rp_n) / bnorm < tol)
+            & (jnp.linalg.norm(rd_n) / cnorm < tol)
+            & (comp_n / objmag < tol)
+        ) | (~ok)
+        return (x_n, y_n, zl_n, zu_n, it + 1, done)
+
+    state = lax.while_loop(cond, body, (x0, y0, z0l, z0u, jnp.array(0), jnp.array(False)))
+    x, y, zl, zu, it, done = state
+    rp, rd, comp = residuals(x, y, zl, zu)
+    # report convergence from actual final residuals (the loop's `done` flag
+    # may also fire on the numerical-breakdown guard); accept a modestly
+    # looser threshold than `tol` since breakdown can stop us a hair early
+    conv = (
+        (jnp.linalg.norm(rp) / bnorm < 100 * tol)
+        & (jnp.linalg.norm(rd) / cnorm < 100 * tol)
+        & (comp / (1.0 + jnp.abs(c @ x)) < 100 * tol)
+    )
+    return IPMSolution(
+        x=x,
+        y=y,
+        zl=zl,
+        zu=zu,
+        obj=c @ x + c0,
+        converged=conv,
+        iterations=it,
+        res_primal=jnp.linalg.norm(rp) / bnorm,
+        res_dual=jnp.linalg.norm(rd) / cnorm,
+        gap=comp / (1.0 + jnp.abs(c @ x)),
+    )
+
+
+def solve_lp_batch(lp: LPData, **kw) -> IPMSolution:
+    """vmap convenience over a leading batch axis present on any LP field.
+
+    Fields without the batch axis are broadcast (e.g. shared A with
+    per-scenario b/c — the common price-taker case where only LMPs differ,
+    reference `wind_battery_LMP.py:243-244`).
+    """
+    batch = None
+    axes = []
+    for name, arr in zip(LPData._fields, lp):
+        base_ndim = {"A": 2, "b": 1, "c": 1, "l": 1, "u": 1, "c0": 0}[name]
+        if arr.ndim == base_ndim + 1:
+            axes.append(0)
+            batch = arr.shape[0]
+        elif arr.ndim == base_ndim:
+            axes.append(None)
+        else:
+            raise ValueError(f"bad ndim for {name}")
+    if batch is None:
+        return solve_lp(lp, **kw)
+    fn = jax.vmap(lambda d: solve_lp(d, **kw), in_axes=(LPData(*axes),))
+    return fn(lp)

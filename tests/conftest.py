@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU mesh + float64.
+
+Multi-chip sharding paths are validated on virtual CPU devices
+(`xla_force_host_platform_device_count`), matching how the driver dry-runs
+`__graft_entry__.dryrun_multichip`. Real-TPU benchmarking happens in bench.py,
+not in tests.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,81 @@
+"""IPM LP solver vs scipy.optimize.linprog on random and structured LPs."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from scipy.optimize import linprog
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.solvers.ipm import solve_lp, solve_lp_batch
+
+
+def random_lp(rng, m=12, n=30, free_frac=0.0, upper_frac=0.5):
+    A = rng.standard_normal((m, n))
+    x_feas = rng.uniform(0.5, 1.5, n)
+    b = A @ x_feas
+    c = rng.standard_normal(n)
+    l = np.zeros(n)
+    u = np.full(n, np.inf)
+    iu = rng.random(n) < upper_frac
+    u[iu] = x_feas[iu] + rng.uniform(0.5, 3.0, iu.sum())
+    ifr = rng.random(n) < free_frac
+    l[ifr] = -10.0
+    return A, b, c, l, u
+
+
+def scipy_solve(A, b, c, l, u):
+    res = linprog(
+        c,
+        A_eq=A,
+        b_eq=b,
+        bounds=list(zip(l, [None if not np.isfinite(x) else x for x in u])),
+        method="highs",
+    )
+    return res
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_ipm_matches_scipy(seed):
+    rng = np.random.default_rng(seed)
+    A, b, c, l, u = random_lp(rng)
+    ref = scipy_solve(A, b, c, l, u)
+    assert ref.status == 0
+    lp = LPData(*(jnp.asarray(v) for v in (A, b, c, l, u, 0.0)))
+    sol = solve_lp(lp, tol=1e-9)
+    assert bool(sol.converged)
+    assert float(sol.obj) == pytest.approx(ref.fun, rel=1e-6, abs=1e-6)
+
+
+def test_ipm_bounded_box_only():
+    # min -x - 2y s.t. x + y = 1, 0 <= x,y <= 0.8  -> x=0.2, y=0.8
+    lp = LPData(
+        A=jnp.array([[1.0, 1.0]]),
+        b=jnp.array([1.0]),
+        c=jnp.array([-1.0, -2.0]),
+        l=jnp.zeros(2),
+        u=jnp.array([0.8, 0.8]),
+        c0=jnp.array(0.0),
+    )
+    sol = solve_lp(lp)
+    assert float(sol.obj) == pytest.approx(-1.8, abs=1e-7)
+    np.testing.assert_allclose(np.asarray(sol.x), [0.2, 0.8], atol=1e-6)
+
+
+def test_ipm_batch_vmap():
+    rng = np.random.default_rng(7)
+    A, b, c, l, u = random_lp(rng)
+    # batch over 16 cost vectors (the LMP-scenario axis)
+    C = np.stack([c * (1 + 0.1 * k) + 0.05 * rng.standard_normal(c.size) for k in range(16)])
+    lp = LPData(
+        A=jnp.asarray(A),
+        b=jnp.asarray(b),
+        c=jnp.asarray(C),
+        l=jnp.asarray(l),
+        u=jnp.asarray(u),
+        c0=jnp.asarray(0.0),
+    )
+    sol = solve_lp_batch(lp, tol=1e-9)
+    assert sol.x.shape == (16, c.size)
+    for k in range(16):
+        ref = scipy_solve(A, b, C[k], l, u)
+        assert float(sol.obj[k]) == pytest.approx(ref.fun, rel=1e-6, abs=1e-6)

@@ -1,0 +1,53 @@
+"""Extract the RTS-GMLC bus-303 DA/RT LMP + wind capacity-factor series used by
+the renewables case studies into a compact npz fixture.
+
+The reference's `load_parameters.py:82-117` reads
+`Wind_Thermal_Dispatch.csv` (absent from this snapshot) and selects one
+non-leap year starting 2020-01-02 at bus 303. The snapshot ships the same
+kind of series as `303_LMPs_15_reserve_500_shortfall.parquet` (RT/DA LMP +
+RT/DA wind CF at bus 303); we apply the same date selection and persist the
+numeric series (data, not code) so golden tests and benchmarks are
+self-contained. Model-result goldens are therefore validated against a CPU
+HiGHS solve of the identical LP rather than the reference's CSV-specific
+dollar figures.
+
+Usage: python tools/extract_rts_data.py /root/reference /root/repo/dispatches_tpu/data
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+
+def main(ref_root: str, out_dir: str):
+    pq = (
+        Path(ref_root)
+        / "dispatches/case_studies/renewables_case/data/303_LMPs_15_reserve_500_shortfall.parquet"
+    )
+    df = pd.read_parquet(pq)
+    start = pd.Timestamp("2020-01-02 00:00:00")
+    ix = pd.date_range(
+        start=start,
+        end=start + pd.offsets.DateOffset(days=365) - pd.offsets.DateOffset(hours=1),
+        freq="1h",
+    )
+    ix = ix[(ix.day != 29) | (ix.month != 2)]
+    df = df[df.index.isin(ix)]
+    out = {
+        "da_lmp": df["LMP DA"].values.astype(np.float64),
+        "rt_lmp": df["LMP"].values.astype(np.float64),
+        "da_wind_cf": df["303_WIND_1-DACF"].values.astype(np.float64),
+        "rt_wind_cf": df["303_WIND_1-RTCF"].values.astype(np.float64),
+    }
+    # 52 complete weeks (the parquet covers 2020 only; dropping Jan 1 and
+    # Feb 29 leaves 8736 h = 52*168, the reference's dispatch-year length)
+    for k, v in out.items():
+        assert v.shape == (8736,), (k, v.shape)
+    dest = Path(out_dir) / "rts303.npz"
+    np.savez_compressed(dest, **out)
+    print(f"wrote {dest}: " + ", ".join(f"{k}{v.shape}" for k, v in out.items()))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])
