@@ -1,0 +1,107 @@
+"""Benchmark: LMP-scenario price-taker LP solves/sec/chip on TPU.
+
+The reference hot path (BASELINE.md): one Pyomo model rebuild + one CBC/IPOPT
+subprocess solve per LMP scenario per sweep point
+(`wind_battery_LMP.py:195-267`), at weekly granularity
+(`load_parameters.py:104` reshapes the year to 52x168 h). Here the identical
+wind+battery+PEM weekly LP is lowered once and a vmapped interior-point solve
+runs the whole scenario x week batch on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+`vs_baseline` is measured against scipy HiGHS solving the same LPs on the host
+CPU (the same solver class the reference shells out to), solves/sec per chip
+vs solves/sec per CPU process.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from dispatches_tpu.case_studies.renewables import params as P
+    from dispatches_tpu.case_studies.renewables.pricetaker import (
+        HybridDesign,
+        build_pricetaker,
+    )
+    from dispatches_tpu.solvers.ipm import solve_lp
+    from dispatches_tpu.solvers.reference import solve_lp_scipy
+
+    T = 168  # one week per LP (reference weekly granularity)
+    n_weeks = 52
+    n_scenarios = int(os.environ.get("BENCH_SCENARIOS", "8"))
+    data = P.load_rts303()
+
+    design = HybridDesign(
+        T=T,
+        with_battery=True,
+        with_pem=True,
+        design_opt=True,
+        h2_price_per_kg=2.5,
+        initial_soc_fixed=None,
+    )
+    prog, _ = build_pricetaker(design)
+
+    lmp_weeks = data["da_lmp"].reshape(n_weeks, T)
+    cf_weeks = data["da_wind_cf"].reshape(n_weeks, T)
+    rng = np.random.default_rng(0)
+    scale = rng.uniform(0.5, 2.0, n_scenarios)
+    # batch axis = scenario x week
+    lmps = (scale[:, None, None] * lmp_weeks[None]).reshape(-1, T).astype(np.float32)
+    cfs = np.broadcast_to(cf_weeks[None], (n_scenarios, n_weeks, T)).reshape(-1, T)
+    cfs = cfs.astype(np.float32)
+    B = lmps.shape[0]
+
+    tol = 1e-5  # f32 on TPU; NPV golden tolerance is 1e-3 rel
+
+    def solve_batch(lmp_b, cf_b):
+        def one(lm, cf):
+            lp = prog.instantiate({"lmp": lm, "wind_cf": cf}, dtype=jnp.float32)
+            sol = solve_lp(lp, tol=tol, max_iter=50, refine_steps=2)
+            return sol.obj, sol.converged, sol.iterations
+
+        return jax.vmap(one)(lmp_b, cf_b)
+
+    fn = jax.jit(solve_batch)
+    # warmup/compile
+    obj, conv, iters = fn(jnp.asarray(lmps[:B]), jnp.asarray(cfs[:B]))
+    obj.block_until_ready()
+
+    t0 = time.perf_counter()
+    obj, conv, iters = fn(jnp.asarray(lmps), jnp.asarray(cfs))
+    obj.block_until_ready()
+    dt = time.perf_counter() - t0
+    solves_per_sec = B / dt
+    conv_frac = float(np.mean(np.asarray(conv)))
+
+    # CPU baseline: HiGHS on a sample of the same LPs
+    n_cpu = min(8, B)
+    t0 = time.perf_counter()
+    for k in range(n_cpu):
+        lp = prog.instantiate(
+            {"lmp": jnp.asarray(lmps[k], jnp.float64), "wind_cf": jnp.asarray(cfs[k], jnp.float64)}
+        )
+        solve_lp_scipy(lp)
+    cpu_dt = (time.perf_counter() - t0) / n_cpu
+    cpu_solves_per_sec = 1.0 / cpu_dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
+                f"(T=168h, batch={B}, converged={conv_frac:.3f})",
+                "value": round(solves_per_sec, 3),
+                "unit": "solves/sec",
+                "vs_baseline": round(solves_per_sec / cpu_solves_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -401,10 +401,16 @@ class Expr:
     def _scaled_by_param(self, pv: ParamView) -> "Expr":
         """Elementwise product with a param vector aligned to rows."""
         pidx_all = pv.pidx
+        target = self
         if len(pidx_all) == 1 and self.R != 1:
             pidx_all = np.broadcast_to(pidx_all, (self.R,))
-        if len(pidx_all) != self.R:
+        elif self.R == 1 and len(pidx_all) > 1:
+            # broadcast a scalar expression across the param's rows, e.g.
+            # ``cf * capacity`` with cf a (T,) param and capacity a scalar var
+            target = self + Expr(len(pidx_all), [], [])
+        if len(pidx_all) != target.R:
             raise ValueError("param factor must match rows")
+        self = target
         terms, consts = [], []
         for b in self.terms:
             if b.pname is not None:

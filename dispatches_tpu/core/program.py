@@ -29,6 +29,24 @@ class LPData(NamedTuple):
     c0: jnp.ndarray  # ()
 
 
+class SparseLP(NamedTuple):
+    """Same LP with A in COO form for matrix-free first-order solvers.
+
+    `rows`/`cols` are static index arrays (the sparsity pattern never changes
+    across scenarios); only `vals` may be parametric. Shape carried statically
+    on the CompiledLP that produced it.
+    """
+
+    rows: jnp.ndarray  # (nnz,) int32
+    cols: jnp.ndarray  # (nnz,) int32
+    vals: jnp.ndarray  # (nnz,)
+    b: jnp.ndarray  # (M,)
+    c: jnp.ndarray  # (N,)
+    l: jnp.ndarray  # (N,)
+    u: jnp.ndarray  # (N,)
+    c0: jnp.ndarray  # ()  (M, N recoverable from b/c shapes)
+
+
 @dataclasses.dataclass
 class _ParamGroup:
     rows: np.ndarray
@@ -119,17 +137,65 @@ class CompiledLP:
             off += e.R
 
         (t, tp, c, cp) = _collect(m._eq + m._le, eq_offs + le_offs)
-        # slack identity entries on le rows
+
+        # original-variable bounds and fixed-variable presolve: columns with
+        # lb == ub (Pyomo's var.fix() idiom, e.g. extant wind capacity,
+        # `wind_battery_PEM_LMP.py:231`) are substituted out — an interior
+        # point method needs a strict interior, and carrying pinned columns
+        # would also waste factorization work
+        lb_o = np.zeros(n)
+        ub_o = np.full(n, np.inf)
+        for vm in self._vars.values():
+            lb_o[vm.start : vm.start + vm.size] = vm.lb
+            ub_o[vm.start : vm.start + vm.size] = vm.ub
+        fixed = np.isfinite(lb_o) & (ub_o - lb_o <= 0.0)
+        fixed_vals = np.where(fixed, lb_o, 0.0)
+        keep = ~fixed
+        n_keep = int(keep.sum())
+        col_map = -np.ones(n, dtype=np.int64)
+        col_map[keep] = np.arange(n_keep)
+        self._n_full = n
+        self._keep_cols = np.where(keep)[0]
+        self._fixed_vals = fixed_vals
+        self.N = n_keep + Mi
+
+        def split_A(rows, cols, scale, pidx=None):
+            """Partition triplets into kept-A entries and rhs contributions."""
+            isfix = fixed[cols]
+            a = (rows[~isfix], col_map[cols[~isfix]], scale[~isfix])
+            # moving a_ij * v_j to the rhs: b_i -= a_ij * v_j
+            bpart = (rows[isfix], -scale[isfix] * fixed_vals[cols[isfix]])
+            if pidx is not None:
+                a = a + (pidx[~isfix],)
+                bpart = bpart + (pidx[isfix],)
+            return a, bpart
+
+        (ar, ac, av), (br_f, bv_f) = split_A(t[0], t[1], t[2])
         slack_rows = np.arange(Me, Me + Mi, dtype=np.int64)
-        slack_cols = np.arange(n, n + Mi, dtype=np.int64)
-        self.A_rows = np.concatenate([t[0], slack_rows])
-        self.A_cols = np.concatenate([t[1], slack_cols])
-        self.A_vals = np.concatenate([t[2], np.ones(Mi)])
-        self.A_pgroups = tp  # name -> (rows, cols, scale, pidx)
-        # rhs: A x (+ s) = -const
-        self.b_rows = c[0]
-        self.b_vals = -c[1]
-        self.b_pgroups = {k: (v[0], -v[1], v[2]) for k, v in cp.items()}
+        slack_cols = np.arange(n_keep, n_keep + Mi, dtype=np.int64)
+        self.A_rows = np.concatenate([ar, slack_rows])
+        self.A_cols = np.concatenate([ac, slack_cols])
+        self.A_vals = np.concatenate([av, np.ones(Mi)])
+        self.A_pgroups = {}
+        b_extra_pgroups: Dict[str, list] = {}
+        for k, (rows, cols, scale, pidx) in tp.items():
+            (ar, ac, av, ap), (br, bv, bp) = split_A(rows, cols, scale, pidx)
+            if len(ar):
+                self.A_pgroups[k] = (ar, ac, av, ap)
+            if len(br):
+                b_extra_pgroups.setdefault(k, []).append((br, bv, bp))
+        # rhs: A x (+ s) = -const (+ fixed-column contributions)
+        self.b_rows = np.concatenate([c[0], br_f])
+        self.b_vals = np.concatenate([-c[1], bv_f])
+        self.b_pgroups = {}
+        for k, v in cp.items():
+            b_extra_pgroups.setdefault(k, []).append((v[0], -v[1], v[2]))
+        for k, parts in b_extra_pgroups.items():
+            self.b_pgroups[k] = (
+                np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+                np.concatenate([p[2] for p in parts]),
+            )
 
         # objective
         sense = m._obj_sense
@@ -138,20 +204,39 @@ class CompiledLP:
         else:
             ot = _collect([m._obj], [0])
         (tt, ttp, tc, tcp) = ot
-        self.c_cols = tt[1]
-        self.c_vals = sense * tt[2]
-        self.c_pgroups = {k: (v[1], sense * v[2], v[3]) for k, v in ttp.items()}
+        cfix = fixed[tt[1]]
+        self.c_cols = col_map[tt[1][~cfix]]
+        self.c_vals = sense * tt[2][~cfix]
         self.c0_val = float(sense * tc[1].sum()) if tc[1].size else 0.0
-        self.c0_pgroups = {k: (sense * v[1], v[2]) for k, v in tcp.items()}
+        self.c0_val += float(sense * (tt[2][cfix] * fixed_vals[tt[1][cfix]]).sum())
+        self.c_pgroups = {}
+        self.c0_pgroups = {k: [(sense * v[1], v[2])] for k, v in tcp.items()}
+        for k, (rows, cols, scale, pidx) in ttp.items():
+            isfix = fixed[cols]
+            if (~isfix).any():
+                self.c_pgroups[k] = (
+                    col_map[cols[~isfix]],
+                    sense * scale[~isfix],
+                    pidx[~isfix],
+                )
+            if isfix.any():
+                self.c0_pgroups.setdefault(k, []).append(
+                    (sense * scale[isfix] * fixed_vals[cols[isfix]], pidx[isfix])
+                )
+        self.c0_pgroups = {
+            k: (
+                np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+            )
+            for k, parts in self.c0_pgroups.items()
+        }
         self.obj_sense = sense
 
-        # bounds
+        # bounds of the reduced problem (kept originals + slacks in [0, inf))
         lb = np.zeros(self.N)
         ub = np.full(self.N, np.inf)
-        for vm in self._vars.values():
-            lb[vm.start : vm.start + vm.size] = vm.lb
-            ub[vm.start : vm.start + vm.size] = vm.ub
-        # slacks: [0, inf)
+        lb[:n_keep] = lb_o[keep]
+        ub[:n_keep] = ub_o[keep]
         self.lb = lb
         self.ub = ub
 
@@ -202,8 +287,55 @@ class CompiledLP:
         )
 
     # ------------------------------------------------------------------
+    def instantiate_coo(self, params: Dict[str, jnp.ndarray], dtype=None) -> "SparseLP":
+        """COO variant of `instantiate` for matrix-free solvers (PDHG): the
+        sparsity pattern is static; only values are (possibly) parametric.
+        Duplicate (row, col) entries are kept — matvecs sum them naturally."""
+        dtype = dtype or jnp.result_type(float)
+        rows = [self.A_rows]
+        cols = [self.A_cols]
+        vals = [jnp.asarray(self.A_vals, dtype)]
+        for k, (r, cc, scale, pidx) in self.A_pgroups.items():
+            rows.append(r)
+            cols.append(cc)
+            vals.append(jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx])
+        b = jnp.zeros((self.M,), dtype)
+        b = b.at[self.b_rows].add(jnp.asarray(self.b_vals, dtype))
+        for k, (r, scale, pidx) in self.b_pgroups.items():
+            b = b.at[r].add(jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx])
+        c = jnp.zeros((self.N,), dtype)
+        c = c.at[self.c_cols].add(jnp.asarray(self.c_vals, dtype))
+        for k, (cc, scale, pidx) in self.c_pgroups.items():
+            c = c.at[cc].add(jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx])
+        c0 = jnp.asarray(self.c0_val, dtype)
+        for k, (scale, pidx) in self.c0_pgroups.items():
+            c0 = c0 + jnp.sum(jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx])
+        return SparseLP(
+            rows=jnp.asarray(np.concatenate(rows), jnp.int32),
+            cols=jnp.asarray(np.concatenate(cols), jnp.int32),
+            vals=jnp.concatenate(vals),
+            b=b,
+            c=c,
+            l=jnp.asarray(self.lb, dtype),
+            u=jnp.asarray(self.ub, dtype),
+            c0=c0,
+        )
+
+    # ------------------------------------------------------------------
+    def expand(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Map a reduced solver solution (kept columns + slacks) back to the
+        full original-variable vector, filling presolved-fixed values."""
+        n_keep = len(self._keep_cols)
+        full = jnp.zeros(x.shape[:-1] + (self._n_full,), x.dtype)
+        full = full + jnp.asarray(self._fixed_vals, x.dtype)
+        return full.at[..., self._keep_cols].set(x[..., :n_keep])
+
+    def _full(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self.expand(x) if x.shape[-1] == self.N else x
+
     def extract(self, name: str, x: jnp.ndarray) -> jnp.ndarray:
         """Pull a named variable's values out of a solution vector (batched ok)."""
+        x = self._full(x)
         vm = self._vars[name]
         sl = x[..., vm.start : vm.start + vm.size]
         return sl.reshape(x.shape[:-1] + vm.shape) if vm.shape else sl[..., 0]
@@ -212,6 +344,7 @@ class CompiledLP:
         """Evaluate a named affine expression at solution x (Pyomo Expression
         analogue, e.g. NPV/revenue reporting in `wind_battery_LMP.py:253-263`)."""
         (t, tp, cst, cp, R) = self._exprs[name]
+        x = self._full(x)
         dtype = x.dtype
         out = jnp.zeros(x.shape[:-1] + (R,), dtype=dtype)
         out = out.at[..., t[0]].add(jnp.asarray(t[2], dtype) * x[..., t[1]])

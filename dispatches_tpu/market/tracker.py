@@ -1,0 +1,119 @@
+"""Tracker: rolling-horizon market-dispatch tracking on device.
+
+The TPU-native equivalent of IDAES grid_integration's `Tracker` as used by the
+reference's double-loop (`run_double_loop_PEM.py:167-190`, test behavior in
+`test_multiperiod_wind_battery_doubleloop.py:41-110`): each market interval it
+solves a small LP that follows the market dispatch signal at minimum cost,
+implements the first `n_tracking_hour` hours, and advances the model state.
+
+Formulation: for delivered power p[t] (MW) and dispatch d[t],
+  min  sum_t cost[t] + penalty * sum_t (under[t] + over[t])
+  s.t. p[t] - d[t] = over[t] - under[t],  over, under >= 0
+plus the adapter's physics. One CompiledLP per horizon length; every
+`track_market_dispatch` call is a pure parameter swap + jitted IPM solve, so a
+year of hourly SCED tracking is ~8,760 identical device calls (or one vmapped
+call in batch backtests) instead of 8,760 Pyomo rebuild+subprocess rounds.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..solvers.ipm import solve_lp
+
+
+class Tracker:
+    def __init__(
+        self,
+        tracking_model_object,
+        tracking_horizon: int,
+        n_tracking_hour: int = 1,
+        tracking_penalty: float = 1000.0,  # $/MWh deviation
+        charge_incentive: float = 1e-3,  # tie-break toward storing surplus
+        solver_kw: Optional[dict] = None,
+    ):
+        self.tracking_model_object = tracking_model_object
+        self.tracking_horizon = tracking_horizon
+        self.n_tracking_hour = n_tracking_hour
+        self.solver_kw = solver_kw or {}
+
+        T = tracking_horizon
+        m, power_out_mw = tracking_model_object.build_program(T)
+        dispatch = m.param("dispatch", T)
+        self._under = m.var("track_under", T)
+        self._over = m.var("track_over", T)
+        m.add_eq(power_out_mw - dispatch - self._over + self._under)
+        obj = (
+            tracking_penalty * (self._over + self._under).sum()
+            + m._exprs["total_cost"].sum()
+        )
+        # tie-break: prefer charging storage over curtailment when both are
+        # free (matches the reference solution's behavior, see
+        # `test_multiperiod_wind_battery_doubleloop.py:104-110`)
+        batt = getattr(tracking_model_object, "_handles", {}).get("batt")
+        if batt is not None:
+            obj = obj - charge_incentive * 1e-3 * batt.elec_in.sum()
+        m.minimize(obj)
+        self.program = m.build()
+
+        self.implemented_power: List[float] = []
+        self.daily_stats: List[dict] = []
+        self._last_x = None
+        self._last_params = None
+
+    # ------------------------------------------------------------------
+    def track_market_dispatch(self, market_dispatch, date, hour):
+        T = self.tracking_horizon
+        hour_i = int(str(hour).split(":")[0]) if isinstance(hour, str) else int(hour)
+        mo = self.tracking_model_object
+        params = mo.get_params(_date_index(date), hour_i, T)
+        disp = np.zeros(T)
+        md = np.asarray(market_dispatch, dtype=float)
+        disp[: len(md)] = md[:T]
+        params["dispatch"] = disp
+        jparams = {k: jnp.asarray(v) for k, v in params.items()}
+        lp = self.program.instantiate(jparams)
+        sol = solve_lp(lp, **self.solver_kw)
+        x = sol.x
+        self._last_x, self._last_params = x, jparams
+
+        power = np.asarray(self.program.eval_expr("power_output", x, jparams))
+        self.implemented_power.extend(power[: self.n_tracking_hour].tolist())
+        mo.advance_state(self.program, x, jparams, self.n_tracking_hour)
+        mo.record_results(self.program, x, jparams, date, hour_i)
+        return sol
+
+    # -- accessors mirroring the IDAES Tracker API -----------------------
+    @property
+    def power_output(self):
+        return np.asarray(
+            self.program.eval_expr("power_output", self._last_x, self._last_params)
+        )
+
+    def get_last_delivered_power(self):
+        return self.implemented_power[-1]
+
+    def get_implemented_profile(self):
+        return list(self.implemented_power)
+
+    def extract(self, name):
+        return np.asarray(self.program.extract(name, self._last_x))
+
+    def write_results(self, path):
+        self.tracking_model_object.write_results(path)
+
+
+def _date_index(date) -> int:
+    """Map a date-like to a day index; plain ints pass through, ISO dates
+    count from their year start."""
+    if isinstance(date, (int, np.integer)):
+        return int(date)
+    try:
+        import pandas as pd
+
+        ts = pd.Timestamp(date)
+        return int((ts - pd.Timestamp(year=ts.year, month=1, day=1)).days)
+    except Exception:
+        return 0

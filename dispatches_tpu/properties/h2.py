@@ -1,0 +1,137 @@
+"""Ideal-gas thermodynamics via NIST Shomate equations — pure JAX functions.
+
+Replaces the reference's IDAES Generic Property packages
+(`dispatches/properties/h2_ideal_vap.py:80-160` and
+`hturbine_ideal_vap.py:41-200`): same NIST Webbook Shomate coefficient data
+(public data, cited in the reference to webbook.nist.gov, retrieved Dec 2020),
+same reference state (Tref=298.15 K, Pref=101325 Pa), but expressed as
+differentiable, jit/vmap-compatible functions instead of Pyomo constraint
+blocks.
+
+Species: hydrogen, oxygen, nitrogen, argon, water (vapor phase).
+Units: J, mol, K, Pa throughout.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+R_GAS = 8.31446261815324  # J/mol/K
+T_REF = 298.15
+P_REF = 101325.0
+
+# Shomate coefficients (A..H), valid ranges per NIST; the reference uses one
+# set per species over its whole 273-2000 K state range
+# (`hturbine_ideal_vap.py:55-180`), which we mirror exactly for parity.
+SHOMATE: Dict[str, np.ndarray] = {
+    # A, B, C, D, E, F, G, H  (cp in J/mol/K with t = T/1000; H in kJ/mol)
+    "hydrogen": np.array(
+        [33.066178, -11.363417, 11.432816, -2.772874, -0.158558, -9.980797, 172.707974, 0.0]
+    ),
+    "nitrogen": np.array(
+        [19.50583, 19.88705, -8.598535, 1.369784, 0.527601, -4.935202, 212.39, 0.0]
+    ),
+    "oxygen": np.array(
+        [31.32234, -20.23531, 57.86644, -36.50624, -0.007374, -8.903471, 246.7945, 0.0]
+    ),
+    "water": np.array(
+        [30.092, 6.832514, 6.793435, -2.53448, 0.082139, -250.881, 223.3967, 0.0]
+    ),
+    "argon": np.array(
+        [20.786, 2.82e-7, -1.46e-7, 1.092e-8, -3.66e-8, -6.19735, 179.999, 0.0]
+    ),
+}
+
+MW = {  # kg/mol (`hturbine_ideal_vap.py` parameter_data)
+    "hydrogen": 2.016e-3,
+    "nitrogen": 28.0134e-3,
+    "oxygen": 31.9988e-3,
+    "water": 18.0153e-3,
+    "argon": 39.948e-3,
+}
+
+SPECIES = ["hydrogen", "oxygen", "nitrogen", "argon", "water"]
+_COEF = jnp.asarray(np.stack([SHOMATE[s] for s in SPECIES]))  # (5, 8)
+
+
+def cp_mol(T):
+    """Molar heat capacity [J/mol/K] for all species, shape (..., 5)."""
+    t = jnp.asarray(T)[..., None] / 1000.0
+    A, B, C, D, E = (_COEF[:, i] for i in range(5))
+    return A + B * t + C * t**2 + D * t**3 + E / t**2
+
+
+def enth_mol(T):
+    """Molar enthalpy above the NIST reference [J/mol], shape (..., 5).
+
+    NIST convention: h(T) - h(298.15) = 1000*(A t + B t^2/2 + C t^3/3 +
+    D t^4/4 - E/t + F - H) with t = T/1000, result kJ/mol -> J/mol.
+    """
+    t = jnp.asarray(T)[..., None] / 1000.0
+    A, B, C, D, E, F, _, H = (_COEF[:, i] for i in range(8))
+    kj = A * t + B * t**2 / 2 + C * t**3 / 3 + D * t**4 / 4 - E / t + F - H
+    return 1000.0 * kj
+
+
+def entr_mol(T, P=P_REF):
+    """Standard molar entropy [J/mol/K] at T and pressure P, shape (..., 5)."""
+    t = jnp.asarray(T)[..., None] / 1000.0
+    A, B, C, D, E, _, G, _ = (_COEF[:, i] for i in range(8))
+    s0 = (
+        A * jnp.log(t)
+        + B * t
+        + C * t**2 / 2
+        + D * t**3 / 3
+        - E / (2 * t**2)
+        + G
+    )
+    return s0 - R_GAS * jnp.log(jnp.asarray(P)[..., None] / P_REF)
+
+
+def mix_enthalpy_flow(n, T):
+    """Total enthalpy flow [W] for molar flows n (..., 5) [mol/s] at T [K]."""
+    return jnp.sum(n * enth_mol(T), axis=-1)
+
+
+def mix_entropy_flow(n, T, P):
+    """Total entropy flow [W/K], including ideal mixing entropy."""
+    ntot = jnp.sum(n, axis=-1, keepdims=True)
+    y = n / jnp.maximum(ntot, 1e-300)
+    s_i = entr_mol(T, P) - R_GAS * jnp.log(jnp.maximum(y, 1e-300))
+    return jnp.sum(n * s_i, axis=-1)
+
+
+def isentropic_temperature(n, T_in, P_in, P_out, iters: int = 30):
+    """Solve T_out with S(n, T_out, P_out) = S(n, T_in, P_in) by Newton.
+
+    Fixed-iteration Newton on the entropy balance — differentiable and
+    jit-compatible (composition n is unchanged across an isentropic step, so
+    the mixing term cancels and only pure-component entropies matter).
+    """
+    s_target = mix_entropy_flow(n, T_in, P_in)
+    T = jnp.asarray(T_in, dtype=jnp.result_type(float)) * (
+        jnp.asarray(P_out) / jnp.asarray(P_in)
+    ) ** (2.0 / 7.0)
+    for _ in range(iters):
+        f = mix_entropy_flow(n, T, P_out) - s_target
+        dfdT = jnp.sum(n * cp_mol(T), axis=-1) / T  # dS/dT = sum n_i cp_i / T
+        T = jnp.clip(T - f / dfdT, 150.0, 4000.0)
+    return T
+
+
+def temperature_from_enthalpy(n, H_target, T_guess, iters: int = 30):
+    """Solve T with sum(n h(T)) = H_target by Newton (fixed iterations)."""
+    T = jnp.asarray(T_guess, dtype=jnp.result_type(float))
+    for _ in range(iters):
+        f = mix_enthalpy_flow(n, T) - H_target
+        dfdT = jnp.sum(n * cp_mol(T), axis=-1)
+        T = jnp.clip(T - f / dfdT, 150.0, 4000.0)
+    return T
+
+
+# -- reaction data (`dispatches/properties/h2_reaction.py:74-90`) ------------
+# R1: 2 H2 + O2 -> 2 H2O, dh_rxn = -4.8366e5 J/mol-extent
+DH_RXN_R1 = -4.8366e5
+STOICH_R1 = jnp.asarray([-2.0, -1.0, 0.0, 0.0, 2.0])  # H2, O2, N2, Ar, H2O

@@ -45,8 +45,93 @@ def _max_step(v, dv, mask):
     return jnp.minimum(1.0, jnp.min(ratios, initial=jnp.inf))
 
 
+def _ruiz_scaling(A, iters: int = 8):
+    """Ruiz equilibration: diagonal R, C with R A C having ~unit row/col
+    infinity norms. Essential for IPM robustness on physically-scaled LPs
+    (kW-scale bounds vs $/kWh-scale costs) and for float32 on TPU."""
+    M, N = A.shape
+    r = jnp.ones((M,), A.dtype)
+    cs = jnp.ones((N,), A.dtype)
+
+    def body(_, rc):
+        r, cs = rc
+        As = A * r[:, None] * cs[None, :]
+        rmax = jnp.max(jnp.abs(As), axis=1)
+        r = r / jnp.sqrt(jnp.where(rmax > 0, rmax, 1.0))
+        As = A * r[:, None] * cs[None, :]
+        cmax = jnp.max(jnp.abs(As), axis=0)
+        cs = cs / jnp.sqrt(jnp.where(cmax > 0, cmax, 1.0))
+        return (r, cs)
+
+    r, cs = lax.fori_loop(0, iters, body, (r, cs))
+    return r, cs
+
+
 @partial(jax.jit, static_argnames=("max_iter", "refine_steps"))
 def solve_lp(
+    lp: LPData,
+    tol: float = 1e-8,
+    max_iter: int = 60,
+    reg_p: float = None,
+    reg_d: float = None,
+    refine_steps: int = 2,
+) -> IPMSolution:
+    """Scale (Ruiz + norm), solve, unscale. See `_solve_scaled` for the core.
+
+    Default regularizations are dtype-aware: large enough to keep the normal
+    equations factorizable, small enough not to bias mid-box variables (a
+    primal reg above the barrier weight `z/x` of a variable far from its
+    bounds visibly perturbs the solution).
+    """
+    A0, b0, c0v, l0, u0, off0 = lp
+    if reg_p is None:
+        reg_p = 1e-13 if A0.dtype == jnp.float64 else 1e-8
+    if reg_d is None:
+        reg_d = 1e-12 if A0.dtype == jnp.float64 else 1e-7
+    r, cs = _ruiz_scaling(A0)
+    A = A0 * r[:, None] * cs[None, :]
+    b = b0 * r
+    # variable substitution x = diag(cs) x~ -> bounds divide by cs
+    l = l0 / cs
+    u = u0 / cs
+    c = c0v * cs
+    sig_c = jnp.maximum(1.0, jnp.max(jnp.abs(c)))
+    sig_b = jnp.maximum(
+        1.0,
+        jnp.maximum(
+            jnp.max(jnp.abs(b), initial=0.0),
+            jnp.max(jnp.where(jnp.isfinite(l), jnp.abs(l), 0.0)),
+        ),
+    )
+    sol = _solve_scaled(
+        LPData(A, b / sig_b, c / sig_c, l / sig_b, u / sig_b, jnp.zeros_like(off0)),
+        tol,
+        max_iter,
+        reg_p,
+        reg_d,
+        refine_steps,
+    )
+    # unscale: x = cs * x~ * sig_b ; y = sig_c * r * y~ ; z = sig_c/cs * z~
+    x = sol.x * cs * sig_b
+    y = sol.y * r * sig_c
+    zl = sol.zl / cs * sig_c
+    zu = sol.zu / cs * sig_c
+    obj = c0v @ x + off0
+    return IPMSolution(
+        x=x,
+        y=y,
+        zl=zl,
+        zu=zu,
+        obj=obj,
+        converged=sol.converged,
+        iterations=sol.iterations,
+        res_primal=sol.res_primal,
+        res_dual=sol.res_dual,
+        gap=sol.gap,
+    )
+
+
+def _solve_scaled(
     lp: LPData,
     tol: float = 1e-8,
     max_iter: int = 60,
@@ -112,20 +197,27 @@ def solve_lp(
             + jnp.asarray(reg_p, dtype)
         )
         w = 1.0 / d
+        # absolute dual regularization: A is Ruiz-equilibrated (entries ~1),
+        # so reg_d is already in a meaningful scale; scaling by max(diag K)
+        # would explode when interior variables drive w -> 1/reg_p
         K = (A * w[None, :]) @ A.T
-        K = K + jnp.asarray(reg_d, dtype) * (1.0 + jnp.diagonal(K).max()) * jnp.eye(
-            M, dtype=dtype
-        )
+        K = K + jnp.asarray(reg_d, dtype) * jnp.eye(M, dtype=dtype)
         cf = jax.scipy.linalg.cho_factor(K)
 
         def kkt_solve(rcl, rcu):
             rhat = rd - jnp.where(fl, rcl / xl, 0.0) + jnp.where(fu, rcu / xu, 0.0)
             rhs = rp + A @ (w * rhat)
             dy = jax.scipy.linalg.cho_solve(cf, rhs)
-            for _ in range(refine_steps):
-                resid = rhs - K @ dy
-                dy = dy + jax.scipy.linalg.cho_solve(cf, resid)
             dx = w * (A.T @ dy - rhat)
+            # primal-residual correction: cancellation in `rhs` (rcl/xl terms
+            # blow up near active bounds) leaves A dx != rp at ~sqrt(eps);
+            # the correction (dy+, dx+) = (K^-1 err, w A^T dy+) restores
+            # A dx ~= rp while keeping A^T dy - d dx - rhat = 0 exactly
+            for _ in range(refine_steps):
+                err = rp - A @ dx
+                dy2 = jax.scipy.linalg.cho_solve(cf, err)
+                dy = dy + dy2
+                dx = dx + w * (A.T @ dy2)
             dzl = jnp.where(fl, (rcl - zl_s * dx) / xl, 0.0)
             dzu = jnp.where(fu, (rcu + zu_s * dx) / xu, 0.0)
             return dx, dy, dzl, dzu

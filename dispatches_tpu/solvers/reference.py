@@ -1,0 +1,34 @@
+"""CPU reference solver: scipy HiGHS on the same LPData tensors.
+
+The test-strategy analogue of the reference's CBC/IPOPT golden solves
+(SURVEY.md §4 "golden-number regression tests per workload against CPU
+reference solves"): every TPU-path LP can be cross-solved on the host to
+validate the device solver's objective/solution to tight tolerances.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.program import LPData
+
+
+def solve_lp_scipy(lp: LPData):
+    from scipy.optimize import linprog
+
+    A = np.asarray(lp.A, dtype=np.float64)
+    b = np.asarray(lp.b, dtype=np.float64)
+    c = np.asarray(lp.c, dtype=np.float64)
+    l = np.asarray(lp.l, dtype=np.float64)
+    u = np.asarray(lp.u, dtype=np.float64)
+    bounds = [
+        (
+            None if not np.isfinite(lo) else lo,
+            None if not np.isfinite(hi) else hi,
+        )
+        for lo, hi in zip(l, u)
+    ]
+    res = linprog(c, A_eq=A, b_eq=b, bounds=bounds, method="highs")
+    if res.status != 0:
+        raise RuntimeError(f"HiGHS failed: {res.status} {res.message}")
+    res.obj_with_offset = res.fun + float(lp.c0)
+    return res

@@ -1,0 +1,154 @@
+"""Representative-day time-series clustering — k-means on device.
+
+Parity with reference
+`dispatches/workflow/train_market_surrogates/dynamic/Time_Series_Clustering.py:28-726`:
+slice annual hourly capacity-factor series into 24-h days, filter the
+all-zero / all-full days into their own bins (`:287-362`), fit Euclidean
+k-means over the remaining days (the reference uses tslearn
+`TimeSeriesKMeans`; here Lloyd iterations are a jit/vmapped JAX loop — one
+(n_days, 24) x (k, 24) distance matmul per step, MXU-friendly), and persist
+the model as JSON.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class KMeansResult(NamedTuple):
+    centers: jnp.ndarray  # (k, d)
+    labels: jnp.ndarray  # (n,)
+    inertia: jnp.ndarray  # ()
+
+
+def kmeans(
+    X: jnp.ndarray,
+    k: int,
+    n_iter: int = 100,
+    seed: int = 42,
+    n_init: int = 10,
+) -> KMeansResult:
+    """Euclidean k-means with k-means++ init, best of `n_init` restarts."""
+    X = jnp.asarray(X)
+    n, d = X.shape
+    key = jax.random.PRNGKey(seed)
+
+    def init_pp(key):
+        k1, key = jax.random.split(key)
+        idx0 = jax.random.randint(k1, (), 0, n)
+        centers = jnp.zeros((k, d)).at[0].set(X[idx0])
+
+        def pick(i, carry):
+            centers, key = carry
+            d2 = jnp.min(
+                jnp.sum((X[:, None, :] - centers[None, :, :]) ** 2, -1)
+                + jnp.where(jnp.arange(k)[None, :] >= i, jnp.inf, 0.0),
+                axis=1,
+            )
+            key, kk = jax.random.split(key)
+            probs = d2 / jnp.maximum(d2.sum(), 1e-30)
+            idx = jax.random.choice(kk, n, p=probs)
+            return centers.at[i].set(X[idx]), key
+
+        centers, _ = lax.fori_loop(1, k, pick, (centers, key))
+        return centers
+
+    def lloyd(centers):
+        def step(_, centers):
+            d2 = (
+                jnp.sum(X**2, 1)[:, None]
+                - 2 * X @ centers.T
+                + jnp.sum(centers**2, 1)[None, :]
+            )
+            lab = jnp.argmin(d2, axis=1)
+            one_hot = jax.nn.one_hot(lab, k, dtype=X.dtype)
+            counts = one_hot.sum(0)
+            sums = one_hot.T @ X
+            new = jnp.where(
+                counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
+            )
+            return new
+
+        centers = lax.fori_loop(0, n_iter, step, centers)
+        d2 = (
+            jnp.sum(X**2, 1)[:, None]
+            - 2 * X @ centers.T
+            + jnp.sum(centers**2, 1)[None, :]
+        )
+        lab = jnp.argmin(d2, axis=1)
+        inertia = jnp.sum(jnp.min(d2, axis=1))
+        return centers, lab, inertia
+
+    keys = jax.random.split(key, n_init)
+    centers0 = jax.vmap(init_pp)(keys)
+    centers, labels, inertias = jax.vmap(lloyd)(centers0)
+    best = jnp.argmin(inertias)
+    return KMeansResult(centers[best], labels[best], inertias[best])
+
+
+@dataclasses.dataclass
+class TimeSeriesClustering:
+    """Day-slicing + filtering + k-means over a sweep of annual series."""
+
+    num_clusters: int
+    filter_opt: bool = True
+    metric: str = "euclidean"
+
+    def transform_data(
+        self, cf_series: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(n_runs, 8736) capacity factors -> stacked (N_days, 24) day
+        matrix, plus per-run counts of filtered all-zero and all-max days
+        (`Time_Series_Clustering.py:287-362`)."""
+        runs, T = cf_series.shape
+        days = cf_series.reshape(runs, T // 24, 24)
+        if not self.filter_opt:
+            return days.reshape(-1, 24), np.zeros(runs), np.zeros(runs)
+        day_sums = days.sum(axis=2)
+        zero_mask = day_sums < 1e-8
+        full_mask = (days > 1 - 1e-3).all(axis=2)
+        keep = ~(zero_mask | full_mask)
+        flat = days[keep]
+        return flat, zero_mask.sum(axis=1), full_mask.sum(axis=1)
+
+    def clustering_data(self, cf_series: np.ndarray, seed: int = 42) -> dict:
+        flat, zero_days, full_days = self.transform_data(np.asarray(cf_series))
+        res = kmeans(jnp.asarray(flat), self.num_clusters, seed=seed)
+        self.result = {
+            "centers": np.asarray(res.centers),
+            "labels": np.asarray(res.labels),
+            "inertia": float(res.inertia),
+            "zero_days": zero_days,
+            "full_days": full_days,
+        }
+        return self.result
+
+    def save_clustering_model(self, path: str):
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "n_clusters": self.num_clusters,
+                    "metric": self.metric,
+                    "filter_opt": self.filter_opt,
+                    "cluster_centers": self.result["centers"].tolist(),
+                    "inertia": self.result["inertia"],
+                },
+                f,
+            )
+
+    @staticmethod
+    def load_clustering_model(path: str) -> dict:
+        with open(path) as f:
+            d = json.load(f)
+        d["cluster_centers"] = np.asarray(d["cluster_centers"])
+        return d
+
+    def assign_labels(self, days: np.ndarray, centers: np.ndarray) -> np.ndarray:
+        d2 = ((days[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        return d2.argmin(axis=1)

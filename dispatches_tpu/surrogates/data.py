@@ -1,0 +1,101 @@
+"""Simulation sweep data handling for market-surrogate training.
+
+Parity with reference
+`dispatches/workflow/train_market_surrogates/dynamic/Simulation_Data.py:22-432`
+(`SimulationData`): loads Prescient sweep outputs — an hourly dispatch table
+(runs x 8736 h) and a sweep-input table — and scales dispatch to capacity
+factors per case family (RE/NE/FE). This implementation is array-native
+(everything becomes dense numpy/JAX arrays up front; a 10k-run sweep is a
+single (10000, 8736) array that shards over hosts, SURVEY.md §2.7) with
+CSV/HDF5 readers for the reference's on-disk formats.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Tuple, Union
+
+import numpy as np
+
+HOURS_PER_YEAR = 8736  # 52 weeks, the Prescient sweep convention
+
+
+class SimulationData:
+    def __init__(
+        self,
+        dispatch: Union[str, np.ndarray],
+        inputs: Union[str, np.ndarray],
+        num_sims: Optional[int] = None,
+        case_type: str = "RE",
+        rt_lmp: Optional[np.ndarray] = None,
+        pmax: Optional[np.ndarray] = None,
+    ):
+        if case_type not in ("RE", "NE", "FE"):
+            raise ValueError(f"case_type must be RE, NE or FE, got {case_type}")
+        self.case_type = case_type
+
+        if isinstance(dispatch, str):
+            dispatch, index = self._read_dispatch_csv(dispatch, num_sims)
+        else:
+            dispatch = np.asarray(dispatch, dtype=float)
+            index = np.arange(dispatch.shape[0])
+        if isinstance(inputs, str):
+            inputs = self._read_inputs_h5(inputs, index)
+        else:
+            inputs = np.asarray(inputs, dtype=float)
+
+        if num_sims is not None:
+            dispatch = dispatch[:num_sims]
+            inputs = inputs[:num_sims]
+            index = index[:num_sims]
+        self.dispatch = dispatch  # (n_runs, T)
+        self.inputs = inputs  # (n_runs, d)
+        self.index = index
+        self.rt_lmp = rt_lmp
+        self._pmax = pmax
+
+    # -- readers for the reference's file formats ------------------------
+    @staticmethod
+    def _read_dispatch_csv(path: str, num_sims: Optional[int]):
+        import pandas as pd
+
+        df = pd.read_csv(path, nrows=num_sims)
+        run_index = df.iloc[:, 0].to_numpy(dtype=str)
+        index = np.array(
+            [int(re.split(r"_|\.", r)[1]) for r in run_index], dtype=int
+        )
+        return df.iloc[:, 1:].to_numpy(dtype=float), index
+
+    @staticmethod
+    def _read_inputs_h5(path: str, index: np.ndarray):
+        import pandas as pd
+
+        df = pd.read_hdf(path)
+        ncol = df.shape[1]
+        return df.iloc[index, list(range(1, ncol))].to_numpy(dtype=float)
+
+    # -- scaling ---------------------------------------------------------
+    def pmax_per_run(self) -> np.ndarray:
+        """Per-run maximum power for capacity-factor scaling.
+
+        RE: wind pmax is a swept input (first input column, MW).
+        NE: the RTS-GMLC nuclear unit is 400 MW derated by the swept
+        pmin scaler (`Simulation_Data.py:_read_NE_pmin`).
+        FE: pmax from the swept input (first column).
+        """
+        if self._pmax is not None:
+            return np.asarray(self._pmax, dtype=float)
+        if self.case_type == "NE":
+            return np.full(self.dispatch.shape[0], 400.0)
+        return self.inputs[:, 0].astype(float)
+
+    def dispatch_capacity_factors(self) -> np.ndarray:
+        """Dispatch scaled to [0, 1] capacity factors per run
+        (`Simulation_Data.py:_scale_data`)."""
+        pmax = self.pmax_per_run()
+        return self.dispatch / np.maximum(pmax[:, None], 1e-12)
+
+    def read_data_to_dict(self) -> Tuple[Dict[int, np.ndarray], Dict[int, np.ndarray]]:
+        """Dict view for reference-API familiarity."""
+        d = {int(i): self.dispatch[k] for k, i in enumerate(self.index)}
+        x = {int(i): self.inputs[k] for k, i in enumerate(self.index)}
+        return d, x

@@ -1,0 +1,182 @@
+"""Market-surrogate training: Flax MLPs for dispatch frequency and revenue.
+
+Parity with reference
+`dispatches/workflow/train_market_surrogates/dynamic/Train_NN_Surrogates.py:31-730`:
+sigmoid-MLP surrogates (Adam, MSE, default 500 epochs) mapping sweep inputs ->
+per-cluster dispatch-day frequencies (`train_NN_frequency:356-441`) or annual
+revenue (`train_NN_revenue:444-516`), with R² reporting and the scaling-params
+JSON schema {"xm_inputs", "xstd_inputs", "xmin", "xmax", "y_mean"/"ym",
+"y_std"/"ystd"} that the design-optimization scripts consume
+(`save_model:516-565`).
+
+Training is data-parallel over a device mesh when provided: the batch shards
+over the `data` axis and gradients all-reduce over ICI (replacing the
+reference's single-process Keras `model.fit`).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import flax.linen as nn
+import optax
+
+
+class SurrogateMLP(nn.Module):
+    hidden: Sequence[int]
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        for h in self.hidden:
+            x = nn.sigmoid(nn.Dense(h)(x))
+        return nn.Dense(self.out_dim)(x)
+
+
+def _r2(y_true, y_pred):
+    ss_res = jnp.sum((y_true - y_pred) ** 2, axis=0)
+    ss_tot = jnp.sum((y_true - jnp.mean(y_true, axis=0)) ** 2, axis=0)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30)
+
+
+class TrainedSurrogate:
+    def __init__(self, model, params, scaling: Dict):
+        self.model = model
+        self.params = params
+        self.scaling = scaling
+
+    def predict(self, X):
+        s = self.scaling
+        Xs = (jnp.asarray(X) - jnp.asarray(s["xm_inputs"])) / jnp.asarray(
+            s["xstd_inputs"]
+        )
+        ys = self.model.apply(self.params, Xs)
+        return ys * jnp.asarray(s["y_std"]) + jnp.asarray(s["y_mean"])
+
+    def save(self, weights_path: str, scaling_path: str):
+        flat = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        np.savez(
+            weights_path,
+            **{"/".join(str(p) for p in path): np.asarray(v) for path, v in flat},
+        )
+        with open(scaling_path, "w") as f:
+            scl = {
+                k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                for k, v in self.scaling.items()
+            }
+            json.dump(scl, f)
+
+
+def train_surrogate(
+    X: np.ndarray,
+    y: np.ndarray,
+    hidden: Sequence[int] = (100, 100),
+    epochs: int = 500,
+    lr: float = 1e-3,
+    seed: int = 0,
+    mesh: Optional[object] = None,
+    verbose: bool = False,
+) -> Tuple[TrainedSurrogate, Dict]:
+    """Full-batch Adam on standardized inputs/outputs. Returns the trained
+    surrogate and metrics {"R2": per-output array}."""
+    X = np.asarray(X, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32)
+    if y.ndim == 1:
+        y = y[:, None]
+    xm, xs = X.mean(0), X.std(0) + 1e-12
+    ym, ys = y.mean(0), y.std(0) + 1e-12
+    Xs = (X - xm) / xs
+    Ys = (y - ym) / ys
+
+    model = SurrogateMLP(hidden=tuple(hidden), out_dim=y.shape[1])
+    params = model.init(jax.random.PRNGKey(seed), Xs[:1])
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+        data_sharding = NamedSharding(mesh, PSpec("scenario"))
+        Xs = jax.device_put(jnp.asarray(Xs), data_sharding)
+        Ys = jax.device_put(jnp.asarray(Ys), data_sharding)
+    else:
+        Xs, Ys = jnp.asarray(Xs), jnp.asarray(Ys)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            pred = model.apply(p, Xs)
+            return jnp.mean((pred - Ys) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for e in range(epochs):
+        params, opt_state, loss = step(params, opt_state)
+        if verbose and e % 100 == 0:
+            print(f"epoch {e}: mse {float(loss):.6f}")
+
+    scaling = {
+        "xm_inputs": xm.tolist(),
+        "xstd_inputs": xs.tolist(),
+        "xmin": ((X.min(0) - xm) / xs).tolist(),
+        "xmax": ((X.max(0) - xm) / xs).tolist(),
+        "y_mean": ym.tolist() if ym.size > 1 else float(ym),
+        "y_std": ys.tolist() if ys.size > 1 else float(ys),
+    }
+    sur = TrainedSurrogate(model, params, scaling)
+    pred = np.asarray(sur.predict(X))
+    metrics = {"R2": np.asarray(_r2(jnp.asarray(y), jnp.asarray(pred)))}
+    if verbose:
+        print("R2:", metrics["R2"])
+    return sur, metrics
+
+
+class TrainNNSurrogates:
+    """Reference-API driver (`Train_NN_Surrogates.py:37`): generates label
+    data from a clustering model and trains frequency/revenue surrogates."""
+
+    def __init__(self, simulation_data, clustering_model: Optional[dict] = None):
+        self.simulation_data = simulation_data
+        self.clustering_model = clustering_model
+
+    def generate_label_data_frequency(self) -> np.ndarray:
+        """Per-run cluster frequencies incl. the synthetic 0/1-cf bins
+        (`_generate_label_data:208-322`): output dim = k + 2, rows sum to 1."""
+        from .clustering import TimeSeriesClustering
+
+        sd = self.simulation_data
+        cf = sd.dispatch_capacity_factors()
+        runs, T = cf.shape
+        centers = np.asarray(self.clustering_model["cluster_centers"])
+        k = centers.shape[0]
+        tsc = TimeSeriesClustering(k)
+        freqs = np.zeros((runs, k + 2))
+        days = cf.reshape(runs, T // 24, 24)
+        day_sums = days.sum(axis=2)
+        zero_mask = day_sums < 1e-8
+        full_mask = (days > 1 - 1e-3).all(axis=2)
+        n_days = days.shape[1]
+        for r in range(runs):
+            keep = ~(zero_mask[r] | full_mask[r])
+            freqs[r, 0] = zero_mask[r].sum() / n_days
+            freqs[r, k + 1] = full_mask[r].sum() / n_days
+            if keep.any():
+                lab = tsc.assign_labels(days[r][keep], centers)
+                for c in range(k):
+                    freqs[r, c + 1] = (lab == c).sum() / n_days
+        return freqs
+
+    def train_NN_frequency(self, hidden=(100, 100), epochs=500, **kw):
+        X = self.simulation_data.inputs
+        y = self.generate_label_data_frequency()
+        return train_surrogate(X, y, hidden=hidden, epochs=epochs, **kw)
+
+    def train_NN_revenue(self, revenue: np.ndarray, hidden=(100, 100), epochs=500, **kw):
+        X = self.simulation_data.inputs
+        return train_surrogate(X, np.asarray(revenue), hidden=hidden, epochs=epochs, **kw)

@@ -1,0 +1,111 @@
+"""Synthetic LMP history generation: ARMA models via lax.scan.
+
+Replaces the reference's RAVEN ARMA integration
+(`dispatches/util/syn_hist_generation.py:21`, `syn_hist_integration.py:29-110`
+and `case_studies/nuclear_case/ARMA_Model/`): fit an ARMA(p, q) to an hourly
+LMP series with a Fourier seasonal mean (the RAVEN recipe), then generate
+batches of synthetic realizations on device — one `lax.scan` per realization,
+vmapped over the batch.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class ARMAModel(NamedTuple):
+    ar: jnp.ndarray  # (p,)
+    ma: jnp.ndarray  # (q,)
+    sigma: jnp.ndarray  # innovation std
+    fourier_coef: jnp.ndarray  # (2K,) seasonal mean coefficients
+    fourier_periods: jnp.ndarray  # (K,) periods in hours
+    mean: jnp.ndarray
+
+
+def _fourier_design(T: int, periods: np.ndarray) -> np.ndarray:
+    t = np.arange(T)[:, None]
+    w = 2 * np.pi / periods[None, :]
+    return np.concatenate([np.sin(w * t), np.cos(w * t)], axis=1)
+
+
+def fit_arma(
+    series: np.ndarray,
+    p: int = 2,
+    q: int = 1,
+    fourier_periods: Tuple[float, ...] = (24.0, 168.0, 8760.0),
+) -> ARMAModel:
+    """Host-side fit: OLS Fourier mean + Hannan-Rissanen ARMA estimation
+    (long-AR residuals, then ARMA regression)."""
+    x = np.asarray(series, dtype=float)
+    T = len(x)
+    periods = np.asarray(fourier_periods)
+    F = _fourier_design(T, periods)
+    mean = x.mean()
+    coef, *_ = np.linalg.lstsq(F, x - mean, rcond=None)
+    resid = x - mean - F @ coef
+
+    # stage 1: long AR to estimate innovations
+    m = max(20, 2 * (p + q))
+    X = np.stack([np.roll(resid, k) for k in range(1, m + 1)], axis=1)[m:]
+    yv = resid[m:]
+    phi_long, *_ = np.linalg.lstsq(X, yv, rcond=None)
+    eps = np.zeros_like(resid)
+    eps[m:] = yv - X @ phi_long
+
+    # stage 2: regression on p lags of x and q lags of eps
+    k0 = max(p, q) + m
+    cols = [np.roll(resid, i)[k0:] for i in range(1, p + 1)]
+    cols += [np.roll(eps, j)[k0:] for j in range(1, q + 1)]
+    X2 = np.stack(cols, axis=1)
+    y2 = resid[k0:]
+    theta, *_ = np.linalg.lstsq(X2, y2, rcond=None)
+    ar, ma = theta[:p], theta[p:]
+    fitted_eps = y2 - X2 @ theta
+    sigma = float(np.std(fitted_eps))
+    return ARMAModel(
+        ar=jnp.asarray(ar),
+        ma=jnp.asarray(ma),
+        sigma=jnp.asarray(sigma),
+        fourier_coef=jnp.asarray(coef),
+        fourier_periods=jnp.asarray(periods),
+        mean=jnp.asarray(mean),
+    )
+
+
+def generate(
+    model: ARMAModel,
+    T: int,
+    key,
+    n_realizations: int = 1,
+    clip_min: float = 0.0,
+):
+    """Generate synthetic series, shape (n_realizations, T). jit/vmap-able."""
+    p = model.ar.shape[0]
+    q = model.ma.shape[0]
+    t = jnp.arange(T)[:, None]
+    w = 2 * jnp.pi / model.fourier_periods[None, :]
+    F = jnp.concatenate([jnp.sin(w * t), jnp.cos(w * t)], axis=1)
+    seasonal = model.mean + F @ model.fourier_coef
+
+    def one(k):
+        eps = model.sigma * jax.random.normal(k, (T + q,))
+
+        def step(carry, i):
+            xhist, ehist = carry  # (p,), (q,)
+            e = eps[i + q]
+            val = jnp.dot(model.ar, xhist) + jnp.dot(model.ma, ehist) + e
+            xhist = jnp.roll(xhist, 1).at[0].set(val) if p else xhist
+            ehist = jnp.roll(ehist, 1).at[0].set(e) if q else ehist
+            return (xhist, ehist), val
+
+        (_, _), resid = lax.scan(
+            step, (jnp.zeros((p,)), jnp.zeros((q,))), jnp.arange(T)
+        )
+        return jnp.maximum(seasonal + resid, clip_min)
+
+    keys = jax.random.split(key, n_realizations)
+    return jax.vmap(one)(keys)

@@ -1,0 +1,9 @@
+"""Unit model library — the L2 analogue of `dispatches/unit_models/`."""
+
+from .base import Unit, connect
+from .battery import BatteryStorage
+from .pem import PEMElectrolyzer
+from .splitter import ElectricalSplitter
+from .tank import SimpleHydrogenTank
+from .turbine import HydrogenTurbine
+from .wind import SolarPV, WindPower

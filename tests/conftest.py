@@ -7,7 +7,9 @@ not in tests.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard-set: the ambient environment pins JAX_PLATFORMS to the single real TPU
+# backend; tests must run on the virtual CPU mesh regardless
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,152 @@
+"""Renewables price-taker golden tests.
+
+Strategy (SURVEY.md §4): the reference's dollar goldens are tied to a data CSV
+absent from the snapshot, so each workload is validated against (a) a CPU
+HiGHS solve of the *identical* LP (must match to 1e-6 rel) and (b) closed-form
+hand computations of the dispatch economics where available. Structural
+behavior (battery size -> 0 at these prices, PEM sized > 0 at h2_price=2.5)
+mirrors the reference tests (`test_RE_flowsheet.py:127-181`).
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.case_studies.renewables import params as P
+from dispatches_tpu.case_studies.renewables.pricetaker import (
+    HybridDesign,
+    build_pricetaker,
+    wind_battery_optimize,
+    wind_battery_pem_optimize,
+    wind_battery_pem_tank_turb_optimize,
+)
+from dispatches_tpu.solvers.ipm import solve_lp_batch
+from dispatches_tpu.solvers.reference import solve_lp_scipy
+
+DATA = P.load_rts303()
+
+
+def _cross_check(design, T, lmps=None):
+    prog, _ = build_pricetaker(design)
+    p = {
+        "lmp": jnp.asarray(lmps if lmps is not None else DATA["da_lmp"][:T]),
+        "wind_cf": jnp.asarray(DATA["da_wind_cf"][:T]),
+    }
+    lp = prog.instantiate(p)
+    ref = solve_lp_scipy(lp)
+    return prog, p, lp, ref
+
+
+def test_wind_battery_vs_highs():
+    T = 168
+    res = wind_battery_optimize(T, DATA["da_lmp"], DATA["da_wind_cf"])
+    assert res["converged"]
+    design = HybridDesign(T=T, with_battery=True, initial_soc_fixed=0.0)
+    prog, p, lp, ref = _cross_check(design, T)
+    npv_ref = -ref.obj_with_offset / 1e-5
+    assert res["NPV"] == pytest.approx(npv_ref, rel=2e-5)
+    # at these LMPs battery adds no value (mirrors `test_RE_flowsheet.py:135`)
+    assert res["batt_kw"] == pytest.approx(0.0, abs=1.0)
+
+
+def test_wind_battery_closed_form():
+    """With battery at 0, optimal dispatch is sell-all-wind with curtailment
+    at negative LMPs; NPV has a closed form."""
+    T = 168
+    res = wind_battery_optimize(T, DATA["da_lmp"], DATA["da_wind_cf"])
+    lmp, cf = DATA["da_lmp"][:T], DATA["da_wind_cf"][:T]
+    wind_kw = P.FIXED_WIND_MW * 1e3
+    rev = np.sum(np.maximum(lmp, 0) * 1e-3 * cf) * wind_kw
+    om = T * wind_kw * P.WIND_OP_COST / 8760
+    npv = P.PA * 52 * (rev - om)
+    assert res["NPV"] == pytest.approx(npv, rel=2e-5)
+
+
+def test_wind_pem_vs_highs():
+    T = 144
+    res = wind_battery_pem_optimize(
+        T, DATA["da_lmp"], DATA["da_wind_cf"], h2_price_per_kg=2.5, design_opt="PEM"
+    )
+    assert res["converged"]
+    design = HybridDesign(
+        T=T,
+        with_battery=True,
+        with_pem=True,
+        design_opt="PEM",
+        batt_mw=0.0,
+        h2_price_per_kg=2.5,
+        initial_soc_fixed=None,
+    )
+    prog, p, lp, ref = _cross_check(design, T)
+    npv_ref = -ref.obj_with_offset / 1e-5
+    assert res["NPV"] == pytest.approx(npv_ref, rel=2e-5)
+    # at h2=$2.5/kg the PEM is sized large (reference finds 487 MW on its data,
+    # `test_RE_flowsheet.py:148`); on this LMP series it should still be deep
+    # into the hundreds of MW
+    assert res["pem_kw"] > 1e5
+    assert res["batt_kw"] == pytest.approx(0.0, abs=1.0)
+
+
+def test_wind_pem_h2_marginal_economics():
+    """PEM capacity's shadow economics: with zero-LMP hours, producing H2 at
+    $2.5/kg beats selling at LMP whenever lmp*1e-3 < h2_value_per_kwh."""
+    T = 144
+    res = wind_battery_pem_optimize(
+        T, DATA["da_lmp"], DATA["da_wind_cf"], h2_price_per_kg=2.5, design_opt="PEM"
+    )
+    sol, prog = res["solution"], res["program"]
+    pem_elec = np.asarray(prog.extract("pem.electricity", sol.x))
+    lmp = DATA["da_lmp"][:T]
+    h2_value_per_kwh = 2.5 * 0.00275984 * 3600 / 500  # ~0.0497 $/kWh
+    pem_cap = res["pem_kw"]
+    wind_avail = P.FIXED_WIND_MW * 1e3 * DATA["da_wind_cf"][:T]
+    # in hours where LMP is clearly below H2 value and wind is available,
+    # the PEM must run at min(wind, cap)
+    mask = (lmp * 1e-3 < 0.9 * h2_value_per_kwh) & (wind_avail > 0)
+    expect = np.minimum(wind_avail[mask], pem_cap)
+    np.testing.assert_allclose(pem_elec[mask], expect, rtol=1e-4, atol=1.0)
+
+
+def test_wind_battery_pem_tank_turb_vs_highs():
+    T = 144
+    res = wind_battery_pem_tank_turb_optimize(
+        T, DATA["da_lmp"], DATA["da_wind_cf"], h2_price_per_kg=2.0
+    )
+    assert res["converged"]
+    design = HybridDesign(
+        T=T,
+        with_battery=True,
+        with_pem=True,
+        with_tank_turbine=True,
+        h2_price_per_kg=2.0,
+        initial_soc_fixed=None,
+    )
+    prog, p, lp, ref = _cross_check(design, T)
+    npv_ref = -ref.obj_with_offset / 1e-5
+    assert res["NPV"] == pytest.approx(npv_ref, rel=2e-5)
+    # mirrors `test_RE_flowsheet.py:173-177`: tank and turbine not built
+    assert res["tank_mol"] == pytest.approx(0.0, abs=2.0)
+    assert res["turb_kw"] == pytest.approx(0.0, abs=2.0)
+
+
+def test_scenario_batch_matches_per_scenario():
+    """The scenario-vmapped solve (the framework's raison d'être) matches
+    per-scenario HiGHS solves."""
+    T = 72
+    S = 8
+    rng = np.random.default_rng(0)
+    design = HybridDesign(T=T, with_battery=True, initial_soc_fixed=0.0)
+    prog, _ = build_pricetaker(design)
+    base_lmp = DATA["da_lmp"][:T]
+    lmps = np.stack([base_lmp * s for s in rng.uniform(0.5, 2.0, S)])
+    cf = jnp.asarray(DATA["da_wind_cf"][:T])
+
+    import jax
+
+    lp_batch = jax.vmap(lambda lm: prog.instantiate({"lmp": lm, "wind_cf": cf}))(
+        jnp.asarray(lmps)
+    )
+    sols = solve_lp_batch(lp_batch)
+    for k in range(S):
+        lp_k = prog.instantiate({"lmp": jnp.asarray(lmps[k]), "wind_cf": cf})
+        ref = solve_lp_scipy(lp_k)
+        assert float(sols.obj[k]) == pytest.approx(ref.obj_with_offset, rel=2e-5, abs=1e-3)

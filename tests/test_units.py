@@ -1,0 +1,106 @@
+"""Unit-model physics goldens — mirrors `dispatches/unit_models/tests/`."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu import Model, solve_lp
+from dispatches_tpu.units import BatteryStorage, PEMElectrolyzer, SimpleHydrogenTank, WindPower
+
+
+def test_battery_single_step_golden():
+    """Reference `test_battery.py:40-67`: 5 kW charge for 1 hr at eta=0.95
+    gives SoC 4.75 kWh and throughput 2.5 kWh."""
+    m = Model("batt_test")
+    batt = BatteryStorage(
+        m, T=1, power_capacity=5.0, duration=4.0, initial_soc=0.0,
+        periodic_soc=False,
+    )
+    m.add_eq(batt.elec_in[0:1] - 5.0)
+    m.add_eq(batt.elec_out[0:1] - 0.0)
+    m.minimize(batt.soc.sum() * 0.0)
+    prog = m.build()
+    sol = solve_lp(prog.instantiate({}))
+    assert bool(sol.converged)
+    assert float(prog.extract("battery.soc", sol.x)[0]) == pytest.approx(4.75, abs=1e-5)
+    assert float(prog.extract("battery.throughput", sol.x)[0]) == pytest.approx(
+        2.5, abs=1e-5
+    )
+
+
+def test_battery_degradation_cap():
+    """SoC ceiling shrinks with throughput: soc <= 4P - 1e-4 * throughput
+    (`battery.py:155-157`)."""
+    m = Model("deg")
+    batt = BatteryStorage(
+        m, T=2, power_capacity=10.0, duration=1.0, initial_soc=0.0,
+        periodic_soc=False,
+    )
+    # charge as much as possible both hours
+    m.maximize(batt.soc[1:2])
+    prog = m.build()
+    sol = solve_lp(prog.instantiate({}))
+    soc = np.asarray(prog.extract("battery.soc", sol.x))
+    # max charge: in=10 -> soc1=9.5, tp1=5; soc2 <= 10 - 1e-4*tp2
+    assert soc[1] <= 10.0 - 1e-4 * 5.0 + 1e-6
+
+
+def test_wind_curtailment():
+    """electricity <= capacity * cf with curtailment allowed
+    (`wind_power.py:120-122`)."""
+    m = Model("windt")
+    w = WindPower(m, T=3, capacity=100.0)
+    lmp = m.param("lmp", 3)
+    m.maximize((lmp * w.electricity).sum())
+    prog = m.build()
+    cf = np.array([0.5, 1.0, 0.25])
+    sol = solve_lp(
+        prog.instantiate({"wind.cf": jnp.asarray(cf), "lmp": jnp.asarray([1.0, -1.0, 1.0])})
+    )
+    elec = np.asarray(prog.extract("wind.electricity", sol.x))
+    np.testing.assert_allclose(elec, [50.0, 0.0, 25.0], atol=1e-5)
+
+
+def test_pem_conversion():
+    """H2 output = electricity * 0.00275984 mol/s/kW (`RE_flowsheet.py:131`)."""
+    m = Model("pemt")
+    pem = PEMElectrolyzer(m, T=1)
+    m.add_eq(pem.electricity[0:1] - 1000.0)
+    m.minimize(pem.electricity.sum() * 0.0)
+    prog = m.build()
+    sol = solve_lp(prog.instantiate({}))
+    elec = float(prog.extract("pem.electricity", sol.x)[0])
+    assert elec * 0.00275984 == pytest.approx(2.75984, abs=1e-4)
+
+
+def test_simple_tank_holdup_balance():
+    """holdup[t] - holdup[t-1] = (in - out_turb - out_pipe)*3600
+    (`hydrogen_tank_simplified.py:178-184`)."""
+    m = Model("tankt")
+    pem = PEMElectrolyzer(m, T=2)
+    tank = SimpleHydrogenTank(
+        m, T=2, inlet_mol=pem.h2_flow_mol, capacity_mol=1e6, periodic_holdup=False
+    )
+    m.add_eq(pem.electricity - np.array([1000.0, 0.0]))
+    m.add_eq(tank.outlet_to_turbine - 0.0)
+    m.add_eq(tank.outlet_to_pipeline[1:2] - 1.0)
+    m.add_eq(tank.outlet_to_pipeline[0:1])
+    m.minimize(tank.holdup.sum() * 0.0)
+    prog = m.build()
+    sol = solve_lp(prog.instantiate({}))
+    assert bool(sol.converged)
+    holdup = np.asarray(prog.extract("h2_tank.holdup", sol.x))
+    infl = 1000.0 * 0.00275984
+    np.testing.assert_allclose(holdup, [infl * 3600, infl * 3600 - 3600.0], rtol=1e-4)
+
+
+def test_turbine_thermo_chain():
+    """Physical sanity of the compressor→combustor→expander chain
+    (cf. `hydrogen_turbine_unit.py:97-167`): net production positive, combustor
+    hot, net specific output ~20-40 kWh/kg H2."""
+    from dispatches_tpu.properties.hturbine import turbine_chain
+
+    st = turbine_chain(1.0)
+    assert float(st.net_power) > 0
+    assert 1500 < float(st.T_reactor_out) < 3000
+    kwh_per_kg = float(st.net_power) / 1e3 / (0.99 * 2.016e-3 * 3600)
+    assert 20 < kwh_per_kg < 40
