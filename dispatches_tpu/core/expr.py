@@ -83,6 +83,7 @@ class Param:
 class ParamView:
     """An indexed slice of a Param, usable in expressions."""
 
+    __array_priority__ = 1000
     __slots__ = ("param", "pidx")
 
     def __init__(self, param: Param, pidx: np.ndarray):
@@ -159,6 +160,7 @@ class _ConstBlock:
 class Var:
     """A (block of) decision variable(s) with static bounds."""
 
+    __array_priority__ = 1000
     __slots__ = ("name", "cols", "shape")
 
     def __init__(self, name: str, cols: np.ndarray, shape: Tuple[int, ...]):
@@ -202,6 +204,7 @@ class Var:
 class VarView:
     """An indexed subset of a Var's columns."""
 
+    __array_priority__ = 1000
     __slots__ = ("cols",)
 
     def __init__(self, cols: np.ndarray):
@@ -264,6 +267,7 @@ class Expr:
     constraint rows (or objective row 0 after ``.sum()``).
     """
 
+    __array_priority__ = 1000
     __slots__ = ("R", "terms", "consts")
 
     def __init__(self, R: int, terms: List[_TermBlock], consts: List[_ConstBlock]):

@@ -31,13 +31,16 @@ class Tracker:
         tracking_horizon: int,
         n_tracking_hour: int = 1,
         tracking_penalty: float = 1000.0,  # $/MWh deviation
-        charge_incentive: float = 1e-3,  # tie-break toward storing surplus
+        curtailment_cost: float = 0.1,  # $/MWh tie-break: prefer storing to spilling
+        cycling_cost: float = 0.01,  # $/MWh on battery throughput: no charge/discharge loops
         solver_kw: Optional[dict] = None,
     ):
         self.tracking_model_object = tracking_model_object
         self.tracking_horizon = tracking_horizon
         self.n_tracking_hour = n_tracking_hour
-        self.solver_kw = solver_kw or {}
+        # tight default tolerance: the tie-break costs are ~1e-4 of the
+        # deviation penalty and must still be resolved to pick the vertex
+        self.solver_kw = {"tol": 1e-10, **(solver_kw or {})}
 
         T = tracking_horizon
         m, power_out_mw = tracking_model_object.build_program(T)
@@ -45,16 +48,27 @@ class Tracker:
         self._under = m.var("track_under", T)
         self._over = m.var("track_over", T)
         m.add_eq(power_out_mw - dispatch - self._over + self._under)
+        # mildly discounted deviation weights: when stored energy can't cover
+        # the whole horizon, meet the EARLY hours (the ones actually
+        # implemented) first instead of spreading the shortfall
+        w = tracking_penalty * (0.999 ** np.arange(T))
         obj = (
-            tracking_penalty * (self._over + self._under).sum()
+            ((self._over + self._under) * w).sum()
             + m._exprs["total_cost"].sum()
         )
-        # tie-break: prefer charging storage over curtailment when both are
-        # free (matches the reference solution's behavior, see
-        # `test_multiperiod_wind_battery_doubleloop.py:104-110`)
-        batt = getattr(tracking_model_object, "_handles", {}).get("batt")
+        # tie-breaks: the tracking LP's optimum is a face (many ways to spill
+        # vs store surplus); the reference's simplex solvers pick the
+        # store-don't-spill vertex (`test_multiperiod_wind_battery_doubleloop.py:104-110`).
+        # A small curtailment cost steers the interior-point solution to that
+        # vertex, and a smaller cycling cost forbids simultaneous
+        # charge/discharge loops that a pure charging credit would invite.
+        handles = getattr(tracking_model_object, "_handles", {})
+        wind = handles.get("wind")
+        if wind is not None:
+            obj = obj - (curtailment_cost * 1e-3) * wind.electricity.sum()
+        batt = handles.get("batt")
         if batt is not None:
-            obj = obj - charge_incentive * 1e-3 * batt.elec_in.sum()
+            obj = obj + (cycling_cost * 1e-3) * (batt.elec_in + batt.elec_out).sum()
         m.minimize(obj)
         self.program = m.build()
 

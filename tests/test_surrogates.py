@@ -1,0 +1,125 @@
+"""Surrogate pipeline: data handling, clustering, NN training.
+
+Mirrors the reference's tiny-fixture strategy
+(`train_market_surrogates/dynamic/tests/`, SURVEY.md §4) with synthetic
+fixtures of the same shape.
+"""
+import numpy as np
+import pytest
+
+from dispatches_tpu.surrogates.clustering import TimeSeriesClustering, kmeans
+from dispatches_tpu.surrogates.data import SimulationData
+from dispatches_tpu.surrogates.train import TrainNNSurrogates, train_surrogate
+
+
+@pytest.fixture(scope="module")
+def synthetic_sweep():
+    """10 runs x 8736 h dispatch shaped like clustered day archetypes."""
+    rng = np.random.default_rng(0)
+    n_runs, n_days = 10, 364
+    archetypes = np.stack(
+        [
+            0.5 + 0.4 * np.sin(np.linspace(0, 2 * np.pi, 24)),
+            np.clip(np.linspace(0, 1, 24), 0, 0.9),
+            np.full(24, 0.3),
+        ]
+    )
+    pmax = rng.uniform(100, 400, n_runs)
+    inputs = np.column_stack([pmax, rng.uniform(0, 1, n_runs)])
+    dispatch = np.zeros((n_runs, n_days * 24))
+    for r in range(n_runs):
+        for d in range(n_days):
+            k = rng.integers(0, 5)
+            if k < 3:
+                day = archetypes[k] + 0.01 * rng.standard_normal(24)
+            elif k == 3:
+                day = np.zeros(24)  # all-zero day
+            else:
+                day = np.ones(24)  # all-max day
+            dispatch[r, d * 24 : (d + 1) * 24] = np.clip(day, 0, 1) * pmax[r]
+    return dispatch, inputs, pmax
+
+
+def test_simulation_data_scaling(synthetic_sweep):
+    dispatch, inputs, pmax = synthetic_sweep
+    sd = SimulationData(dispatch, inputs, case_type="RE")
+    cf = sd.dispatch_capacity_factors()
+    assert cf.shape == dispatch.shape
+    assert cf.max() <= 1.0 + 1e-9
+    d, x = sd.read_data_to_dict()
+    assert set(d) == set(range(10))
+
+
+def test_kmeans_recovers_archetypes(synthetic_sweep):
+    dispatch, inputs, pmax = synthetic_sweep
+    sd = SimulationData(dispatch, inputs, case_type="RE")
+    cf = sd.dispatch_capacity_factors()
+    tsc = TimeSeriesClustering(num_clusters=3)
+    res = tsc.clustering_data(cf)
+    assert res["centers"].shape == (3, 24)
+    # filtered days: roughly 1/5 zero and 1/5 full
+    assert res["zero_days"].sum() > 0
+    assert res["full_days"].sum() > 0
+    # centers should match the 3 archetypes up to permutation
+    archetypes = np.stack(
+        [
+            0.5 + 0.4 * np.sin(np.linspace(0, 2 * np.pi, 24)),
+            np.clip(np.linspace(0, 1, 24), 0, 0.9),
+            np.full(24, 0.3),
+        ]
+    )
+    for a in archetypes:
+        dists = np.linalg.norm(res["centers"] - a, axis=1)
+        assert dists.min() < 0.2
+
+
+def test_clustering_save_load(tmp_path, synthetic_sweep):
+    dispatch, inputs, _ = synthetic_sweep
+    sd = SimulationData(dispatch, inputs, case_type="RE")
+    tsc = TimeSeriesClustering(num_clusters=3)
+    tsc.clustering_data(sd.dispatch_capacity_factors())
+    path = str(tmp_path / "model.json")
+    tsc.save_clustering_model(path)
+    loaded = TimeSeriesClustering.load_clustering_model(path)
+    assert loaded["n_clusters"] == 3
+    np.testing.assert_allclose(loaded["cluster_centers"], tsc.result["centers"])
+
+
+def test_frequency_labels_sum_to_one(synthetic_sweep):
+    dispatch, inputs, _ = synthetic_sweep
+    sd = SimulationData(dispatch, inputs, case_type="RE")
+    tsc = TimeSeriesClustering(num_clusters=3)
+    tsc.clustering_data(sd.dispatch_capacity_factors())
+    trainer = TrainNNSurrogates(
+        sd, {"cluster_centers": tsc.result["centers"], "n_clusters": 3}
+    )
+    freqs = trainer.generate_label_data_frequency()
+    assert freqs.shape == (10, 5)  # k + 2 bins
+    np.testing.assert_allclose(freqs.sum(axis=1), 1.0, atol=1e-9)
+
+
+def test_train_revenue_surrogate_r2():
+    """NN fits a smooth synthetic revenue function with high R²
+    (`Train_NN_Surrogates.py:444-516` semantics: standardized IO, Adam/MSE)."""
+    rng = np.random.default_rng(1)
+    X = rng.uniform(-1, 1, (256, 3))
+    y = 5e6 + 1e6 * (X[:, 0] * 2 + np.sin(3 * X[:, 1]) - X[:, 2] ** 2)
+    sur, metrics = train_surrogate(X, y, hidden=(32, 32), epochs=800, lr=3e-3)
+    assert metrics["R2"][0] > 0.97
+    pred = np.asarray(sur.predict(X[:5]))
+    assert pred.shape == (5, 1)
+
+
+def test_scaling_json_schema(tmp_path):
+    rng = np.random.default_rng(2)
+    X = rng.uniform(0, 1, (64, 2))
+    y = X @ np.array([2.0, -1.0]) + 3
+    sur, _ = train_surrogate(X, y, hidden=(8,), epochs=200)
+    wpath, spath = str(tmp_path / "w.npz"), str(tmp_path / "s.json")
+    sur.save(wpath, spath)
+    import json
+
+    s = json.load(open(spath))
+    # schema parity with e.g. RE_revenue_params.json
+    for key in ("xm_inputs", "xstd_inputs", "xmin", "xmax", "y_mean", "y_std"):
+        assert key in s
