@@ -18,4 +18,8 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax
 
+# the ambient axon sitecustomize installs hooks that force
+# jax_platforms="axon,cpu" regardless of the env var; override in-process
+# before any backend is initialized so tests never touch the TPU tunnel
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
