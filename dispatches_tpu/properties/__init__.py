@@ -1,0 +1,9 @@
+"""Property packages — the L1 analogue of `dispatches/properties/`.
+
+`h2` covers the ideal-vapor H2 / turbine-mixture thermodynamics and the H2
+combustion reaction data; `salts` covers the molten-salt and thermal-oil
+heat-transfer-fluid correlations.
+"""
+
+from . import h2
+from .salts import FLUIDS, FluidProps, HitecSalt, SolarSalt, ThermalOil

@@ -53,7 +53,8 @@ MW = {  # kg/mol (`hturbine_ideal_vap.py` parameter_data)
 }
 
 SPECIES = ["hydrogen", "oxygen", "nitrogen", "argon", "water"]
-_COEF = jnp.asarray(np.stack([SHOMATE[s] for s in SPECIES]))  # (5, 8)
+# host-side: a device array here would force JAX backend init at import time
+_COEF = np.stack([SHOMATE[s] for s in SPECIES])  # (5, 8)
 
 
 def cp_mol(T):
@@ -134,4 +135,4 @@ def temperature_from_enthalpy(n, H_target, T_guess, iters: int = 30):
 # -- reaction data (`dispatches/properties/h2_reaction.py:74-90`) ------------
 # R1: 2 H2 + O2 -> 2 H2O, dh_rxn = -4.8366e5 J/mol-extent
 DH_RXN_R1 = -4.8366e5
-STOICH_R1 = jnp.asarray([-2.0, -1.0, 0.0, 0.0, 2.0])  # H2, O2, N2, Ar, H2O
+STOICH_R1 = np.asarray([-2.0, -1.0, 0.0, 0.0, 2.0])  # H2, O2, N2, Ar, H2O

@@ -1,0 +1,103 @@
+"""Property-package tests — parity with the reference's
+`dispatches/properties/tests/test_{solarsalt,hitecsalt,thermaloil}_properties.py`
+pattern: evaluate each correlation at a reference temperature and check
+against hand-computed values from the published coefficients."""
+import numpy as np
+import pytest
+
+from dispatches_tpu.properties import HitecSalt, SolarSalt, ThermalOil
+from dispatches_tpu.properties.h2 import (
+    DH_RXN_R1,
+    STOICH_R1,
+    cp_mol,
+    enth_mol,
+    SPECIES,
+)
+
+
+class TestSolarSalt:
+    # reference state point: T=550 K (`test_solarsalt_properties.py:97`)
+    T = 550.0
+    dT = 550.0 - 273.15
+
+    def test_cp(self):
+        assert SolarSalt.cp_mass(self.T) == pytest.approx(1443 + 0.172 * self.dT)
+
+    def test_density(self):
+        assert SolarSalt.dens_mass(self.T) == pytest.approx(2090 - 0.636 * self.dT)
+
+    def test_enthalpy_is_cp_integral(self):
+        # d(enth)/dT == cp  (enthalpy_correlation `solarsalt_properties.py:312-319`)
+        h1 = SolarSalt.enth_mass(self.T + 0.5)
+        h0 = SolarSalt.enth_mass(self.T - 0.5)
+        assert h1 - h0 == pytest.approx(float(SolarSalt.cp_mass(self.T)), rel=1e-6)
+
+    def test_viscosity_conductivity_positive(self):
+        for T in np.linspace(SolarSalt.T_min, SolarSalt.T_max, 7):
+            assert float(SolarSalt.visc_d(T)) > 0
+            assert float(SolarSalt.therm_cond(T)) > 0
+
+    def test_temperature_from_enthalpy_roundtrip(self):
+        h = SolarSalt.enth_mass(620.0)
+        T = SolarSalt.temperature_from_enthalpy(h, 550.0)
+        assert float(T) == pytest.approx(620.0, abs=1e-6)
+
+
+class TestHitecSalt:
+    T = 600.0
+
+    def test_cp(self):
+        assert HitecSalt.cp_mass(self.T) == pytest.approx(
+            5806 - 10.833 * self.T + 7.2413e-3 * self.T**2
+        )
+
+    def test_density(self):
+        assert HitecSalt.dens_mass(self.T) == pytest.approx(2293.6 - 0.7497 * self.T)
+
+    def test_enthalpy_matches_reference_form(self):
+        # `hitecsalt_properties.py:313-320`: h = c1*T + c2*T^2 + c3*T^3
+        assert HitecSalt.enth_mass(self.T) == pytest.approx(
+            5806 * self.T - 10.833 * self.T**2 + 7.2413e-3 * self.T**3
+        )
+
+    def test_viscosity_log_form(self):
+        expect = np.exp(-4.343 - 2.0143 * (np.log(self.T) - 5.011))
+        assert HitecSalt.visc_d(self.T) == pytest.approx(expect)
+
+
+class TestThermalOil:
+    T = 523.0  # reference initialization point (`thermaloil_properties.py:296`)
+    dT = 523.0 - 273.15
+
+    def test_cp(self):
+        assert ThermalOil.cp_mass(self.T) == pytest.approx(
+            1496.005 + 3.313 * self.dT + 0.0008970785 * self.dT**2
+        )
+
+    def test_kinematic_to_dynamic_viscosity(self):
+        nu = 1e-6 * np.exp(586.375 / (self.dT + 62.5) - 2.2809)
+        rho = 1026.7 - 0.7281 * self.dT
+        assert ThermalOil.visc_d(self.T) == pytest.approx(nu * rho, rel=1e-6)
+
+    def test_conductivity(self):
+        assert ThermalOil.therm_cond(self.T) == pytest.approx(
+            0.118294 - 3.3e-5 * self.dT - 1.5e-7 * self.dT**2
+        )
+
+
+class TestH2Reaction:
+    def test_heat_of_reaction(self):
+        # `h2_reaction.py:81-85`: dh_rxn = -4.8366e5 J/mol
+        assert DH_RXN_R1 == pytest.approx(-4.8366e5)
+
+    def test_stoichiometry_balances_atoms(self):
+        s = np.asarray(STOICH_R1)  # H2, O2, N2, Ar, H2O
+        assert 2 * s[0] + 2 * s[4] == pytest.approx(0)  # H balance
+        assert 2 * s[1] + s[4] == pytest.approx(0)  # O balance
+
+    def test_cp_enthalpy_consistency(self):
+        T = 700.0
+        h1, h0 = enth_mol(T + 0.5), enth_mol(T - 0.5)
+        cp = cp_mol(T)
+        np.testing.assert_allclose(np.asarray(h1 - h0), np.asarray(cp), rtol=1e-4)
+        assert len(SPECIES) == 5
