@@ -1,0 +1,355 @@
+"""Batched primal-dual interior-point solver for smooth NLPs — pure JAX.
+
+TPU-native replacement for the reference's IPOPT subprocess solves
+(SURVEY.md §2.6: `SolverFactory("ipopt")` on flowsheet NLPs, e.g.
+`elec_splitter.py:212-217`, the USC plant, the detailed hydrogen tank).
+Problems are given *functionally* — a JAX objective and equality-constraint
+function — instead of via an algebraic modeling layer: autodiff supplies
+exact gradients, Jacobians, and Lagrangian Hessians, and the whole solve is
+one `lax.while_loop` that jits once and `vmap`s over scenario batches.
+
+    min  f(x, p)
+    s.t. c(x, p) = 0
+         l <= x <= u        (entries may be +-inf)
+
+Algorithm: monotone-barrier primal-dual Newton (Fiacco-McCormick mu
+schedule, fraction-to-boundary rule, Armijo backtracking on an l1-penalty
+barrier merit function, inertia-free dual regularization) — the standard
+IPOPT recipe restructured for fixed-shape XLA compilation: fixed maximum
+iteration counts, masked infinite bounds, LU on the regularized KKT system
+(dense — MXU-friendly at flowsheet sizes).
+
+Also provides `solve_square`: damped Newton for square nonlinear systems,
+the analogue of the reference's flowsheet initialization square solves
+(`nuclear_flowsheet.py:74` + `fix_dof_and_initialize`, SURVEY.md §3.3).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class NLPSolution(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray  # equality-constraint multipliers
+    zl: jnp.ndarray  # lower-bound duals (0 where bound infinite)
+    zu: jnp.ndarray  # upper-bound duals
+    obj: jnp.ndarray
+    converged: jnp.ndarray
+    iterations: jnp.ndarray
+    kkt_error: jnp.ndarray  # max(dual inf, primal inf, complementarity)
+
+
+class _State(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    zl: jnp.ndarray
+    zu: jnp.ndarray
+    mu: jnp.ndarray
+    it: jnp.ndarray
+    done: jnp.ndarray
+    # derivatives at x, carried so each point is differentiated exactly once
+    gf: jnp.ndarray
+    cx: jnp.ndarray
+    J: jnp.ndarray
+
+
+def _kkt_error(grad_L, c, x, zl, zu, l, u, finl, finu, mu):
+    """IPOPT's E_mu (scaled residuals omitted — problems here are prescaled)."""
+    dual = jnp.max(jnp.abs(grad_L))
+    primal = jnp.max(jnp.abs(c)) if c.shape[0] else jnp.asarray(0.0, grad_L.dtype)
+    compl_l = jnp.where(finl, (x - l) * zl - mu, 0.0)
+    compl_u = jnp.where(finu, (u - x) * zu - mu, 0.0)
+    comp = jnp.max(jnp.maximum(jnp.abs(compl_l), jnp.abs(compl_u)))
+    return jnp.maximum(dual, jnp.maximum(primal, comp))
+
+
+def _fraction_to_boundary(d, s, tau):
+    """max alpha in (0,1] with s + alpha*d >= (1-tau)*s, elementwise-masked."""
+    bad = d < 0
+    ratio = jnp.where(bad, -tau * s / jnp.where(bad, d, -1.0), jnp.inf)
+    return jnp.minimum(1.0, jnp.min(ratio))
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "f_obj",
+        "c_eq",
+        "max_iter",
+        "ls_steps",
+    ),
+)
+def solve_nlp(
+    f_obj: Callable,
+    c_eq: Callable,
+    x0: jnp.ndarray,
+    l: jnp.ndarray,
+    u: jnp.ndarray,
+    params=None,
+    tol: float = 1e-8,
+    max_iter: int = 100,
+    mu0: float = 1e-1,
+    ls_steps: int = 25,
+) -> NLPSolution:
+    """Solve min f(x,p) s.t. c(x,p)=0, l<=x<=u from start point x0.
+
+    `f_obj(x, params) -> scalar`, `c_eq(x, params) -> (m,)` must be smooth
+    JAX functions (m may be 0 via an empty array). Infinite bounds are
+    handled by masking. vmap over a leading batch axis of x0/params for
+    scenario batches.
+    """
+    dtype = x0.dtype
+    n = x0.shape[0]
+    l = jnp.broadcast_to(jnp.asarray(l, dtype), (n,))
+    u = jnp.broadcast_to(jnp.asarray(u, dtype), (n,))
+    # variables fixed via equal bounds (the reference's fix-DoF idiom) get a
+    # tiny relaxed box so the log barrier stays finite
+    fixed = jnp.isfinite(l) & jnp.isfinite(u) & (u - l <= 0)
+    l = jnp.where(fixed, l - 1e-8 * (1.0 + jnp.abs(l)), l)
+    u = jnp.where(fixed, u + 1e-8 * (1.0 + jnp.abs(u)), u)
+    finl = jnp.isfinite(l)
+    finu = jnp.isfinite(u)
+
+    f = lambda x: f_obj(x, params)
+    c = lambda x: c_eq(x, params)
+    m = jax.eval_shape(c, x0).shape[0]
+
+    grad_f = jax.grad(f)
+    jac_c = jax.jacfwd(c) if m else None
+
+    def lagrangian(x, y):
+        return f(x) + (jnp.dot(y, c(x)) if m else 0.0)
+
+    hess_L = jax.hessian(lagrangian, argnums=0)
+
+    # interior start: push x0 strictly inside its box (IPOPT's kappa_1 rule)
+    span = jnp.where(finl & finu, u - l, 1.0)
+    pad = 1e-2 * jnp.minimum(1.0, span)
+    x_init = jnp.clip(x0, jnp.where(finl, l + pad, -jnp.inf), jnp.where(finu, u - pad, jnp.inf))
+
+    sl0 = jnp.where(finl, x_init - l, 1.0)
+    su0 = jnp.where(finu, u - x_init, 1.0)
+    state0 = _State(
+        x=x_init,
+        y=jnp.zeros((m,), dtype),
+        zl=jnp.where(finl, mu0 / sl0, 0.0).astype(dtype),
+        zu=jnp.where(finu, mu0 / su0, 0.0).astype(dtype),
+        mu=jnp.asarray(mu0, dtype),
+        it=jnp.asarray(0, jnp.int32),
+        done=jnp.asarray(False),
+        gf=grad_f(x_init),
+        cx=c(x_init) if m else jnp.zeros((0,), dtype),
+        J=jac_c(x_init) if m else jnp.zeros((0, n), dtype),
+    )
+
+    tau = 0.995
+    kappa_mu, theta_mu = 0.2, 1.5
+    nu_pen = 1e2  # l1 penalty weight in the merit function
+
+    def merit(x, mu):
+        sl = jnp.where(finl, x - l, 1.0)
+        su = jnp.where(finu, u - x, 1.0)
+        bar = -mu * (
+            jnp.sum(jnp.where(finl, jnp.log(jnp.maximum(sl, 1e-300)), 0.0))
+            + jnp.sum(jnp.where(finu, jnp.log(jnp.maximum(su, 1e-300)), 0.0))
+        )
+        viol = jnp.sum(jnp.abs(c(x))) if m else 0.0
+        return f(x) + bar + nu_pen * viol
+
+    # regularization ladder for inertia correction: when the Lagrangian
+    # Hessian is indefinite the Newton direction may be ascent; re-solving
+    # with H + delta*I for growing delta (all candidates in ONE batched LU,
+    # then picking the first descent direction) is the XLA-friendly version
+    # of IPOPT's inertia-correction loop
+    DELTAS = (1e-8, 1e-4, 1e-2, 1e0, 1e2, 1e4)
+
+    def step(st: _State) -> _State:
+        x, y, zl, zu, mu = st.x, st.y, st.zl, st.zu, st.mu
+        sl = jnp.where(finl, x - l, 1.0)
+        su = jnp.where(finu, u - x, 1.0)
+
+        gf, cx, J = st.gf, st.cx, st.J
+        H = hess_L(x, y)
+
+        # primal-dual Sigma; zero where no bound
+        sigma = jnp.where(finl, zl / sl, 0.0) + jnp.where(finu, zu / su, 0.0)
+
+        # condensed dual residual after eliminating the bound duals:
+        #   (H + Sigma) dx + J^T dy = -(gf + J^T y - mu/sl + mu/su)
+        rhs_x = gf + (J.T @ y if m else 0.0) - jnp.where(finl, mu / sl, 0.0) + jnp.where(
+            finu, mu / su, 0.0
+        )
+
+        gamma = 1e-8
+        K = jnp.zeros((n + m, n + m), dtype)
+        K = K.at[:n, :n].set(H + jnp.diag(sigma))
+        if m:
+            K = K.at[:n, n:].set(J.T)
+            K = K.at[n:, :n].set(J)
+            K = K.at[n:, n:].set(-gamma * jnp.eye(m, dtype=dtype))
+        rhs = jnp.concatenate([-rhs_x, -cx])
+
+        deltas = jnp.asarray(DELTAS, dtype)
+        eyeb = jnp.zeros((n + m,), dtype).at[:n].set(1.0)
+        Ks = K[None, :, :] + deltas[:, None, None] * jnp.diag(eyeb)[None, :, :]
+        sols = jnp.linalg.solve(
+            Ks, jnp.broadcast_to(rhs, (len(DELTAS), n + m))[..., None]
+        )[..., 0]
+
+        # gradient of the smooth part of the merit (f + barrier) at x
+        g_smooth = gf - jnp.where(finl, mu / sl, 0.0) + jnp.where(finu, mu / su, 0.0)
+        cl1 = jnp.sum(jnp.abs(cx)) if m else jnp.asarray(0.0, dtype)
+        dirderivs = sols[:, :n] @ g_smooth - nu_pen * cl1  # per-delta D(phi; dx)
+        finite = jnp.all(jnp.isfinite(sols), axis=1)
+        good = finite & (dirderivs < 0)
+        # first good candidate; if none, the most-regularized finite one
+        idx_first_good = jnp.argmax(good)
+        idx_fallback = jnp.where(jnp.any(finite), len(DELTAS) - 1 - jnp.argmax(finite[::-1]), 0)
+        idx = jnp.where(jnp.any(good), idx_first_good, idx_fallback)
+        sol = sols[idx]
+        sol = jnp.where(jnp.all(jnp.isfinite(sol)), sol, -jnp.concatenate([g_smooth, jnp.zeros((m,), dtype)]) * 1e-3)
+        dx = sol[:n]
+        dy = sol[n:] if m else jnp.zeros((0,), dtype)
+        D = jnp.minimum(dx @ g_smooth - nu_pen * cl1, -0.0)
+
+        dzl = jnp.where(finl, (mu - zl * sl) / sl - zl / sl * dx, 0.0)
+        dzu = jnp.where(finu, (mu - zu * su) / su + zu / su * dx, 0.0)
+
+        # fraction-to-boundary on primal slacks and duals
+        a_pl = _fraction_to_boundary(dx, jnp.where(finl, sl, jnp.inf), tau)
+        a_pu = _fraction_to_boundary(-dx, jnp.where(finu, su, jnp.inf), tau)
+        alpha_max = jnp.minimum(a_pl, a_pu)
+        a_zl = _fraction_to_boundary(dzl, jnp.where(finl, zl, jnp.inf), tau)
+        a_zu = _fraction_to_boundary(dzu, jnp.where(finu, zu, jnp.inf), tau)
+        alpha_z = jnp.minimum(a_zl, a_zu)
+
+        # Armijo backtracking on the merit function with the true directional
+        # derivative (an absolute cutoff here stalls near convergence where
+        # |D| is tiny)
+        phi0 = merit(x, mu)
+
+        def ls_body(carry, k):
+            alpha, accepted = carry
+            a_try = alpha_max * (0.5**k)
+            phi_try = merit(x + a_try * dx, mu)
+            ok = (phi_try <= phi0 + 1e-4 * a_try * D) & (~accepted)
+            alpha = jnp.where(ok, a_try, alpha)
+            return (alpha, accepted | ok), None
+
+        (alpha, got), _ = lax.scan(
+            ls_body, (alpha_max * 0.5**ls_steps, jnp.asarray(False)), jnp.arange(ls_steps)
+        )
+
+        x_new = x + alpha * dx
+        y_new = y + alpha * dy
+        zl_new = jnp.where(finl, jnp.clip(zl + alpha_z * dzl, 1e-12, 1e16), 0.0)
+        zu_new = jnp.where(finu, jnp.clip(zu + alpha_z * dzu, 1e-12, 1e16), 0.0)
+
+        # convergence + barrier update
+        gfn = grad_f(x_new)
+        cn = c(x_new) if m else jnp.zeros((0,), dtype)
+        Jn = jac_c(x_new) if m else jnp.zeros((0, n), dtype)
+        gL = gfn + (Jn.T @ y_new if m else 0.0) - zl_new + zu_new
+        e_mu = _kkt_error(gL, cn, x_new, zl_new, zu_new, l, u, finl, finu, mu)
+        e_0 = _kkt_error(gL, cn, x_new, zl_new, zu_new, l, u, finl, finu, 0.0)
+
+        mu_new = jnp.where(
+            e_mu < 10.0 * mu,
+            jnp.maximum(tol / 10.0, jnp.minimum(kappa_mu * mu, mu**theta_mu)),
+            mu,
+        )
+        done = e_0 < tol
+        return _State(
+            x_new, y_new, zl_new, zu_new, mu_new, st.it + 1, done, gfn, cn, Jn
+        )
+
+    def cond(st: _State):
+        return (~st.done) & (st.it < max_iter)
+
+    stF = lax.while_loop(cond, step, state0)
+
+    cxF, JF = stF.cx, stF.J
+    gLF = stF.gf + (JF.T @ stF.y if m else 0.0) - stF.zl + stF.zu
+    e0 = _kkt_error(gLF, cxF, stF.x, stF.zl, stF.zu, l, u, finl, finu, 0.0)
+    return NLPSolution(
+        x=stF.x,
+        y=stF.y,
+        zl=stF.zl,
+        zu=stF.zu,
+        obj=f(stF.x),
+        converged=e0 < 10 * tol,
+        iterations=stF.it,
+        kkt_error=e0,
+    )
+
+
+@partial(jax.jit, static_argnames=("F", "max_iter"))
+def solve_square(
+    F: Callable,
+    x0: jnp.ndarray,
+    params=None,
+    tol: float = 1e-10,
+    max_iter: int = 50,
+    damping: float = 1e-10,
+) -> NLPSolution:
+    """Damped Newton for a square system F(x, p) = 0 (n equations, n vars).
+
+    The analogue of the reference's zero-degree-of-freedom flowsheet solves
+    (IPOPT square solve after `fix_dof_and_initialize`, SURVEY.md §3.3), with
+    a Levenberg-style fallback: steps use (J^T J + lambda I) when plain
+    Newton diverges — and halved steps if the residual norm does not drop.
+    """
+    dtype = x0.dtype
+    n = x0.shape[0]
+    Ffun = lambda x: F(x, params)
+    Jfun = jax.jacfwd(Ffun)
+
+    def body(carry):
+        x, it, _ = carry
+        r = Ffun(x)
+        J = Jfun(x)
+        dx = jnp.linalg.solve(J + damping * jnp.eye(n, dtype=dtype), -r)
+        dx = jnp.where(jnp.all(jnp.isfinite(dx)), dx, -J.T @ r * 1e-6)
+
+        nr0 = jnp.linalg.norm(r)
+
+        def ls(carry2, k):
+            alpha, accepted = carry2
+            a_try = 0.5**k
+            ok = (jnp.linalg.norm(Ffun(x + a_try * dx)) < nr0) & (~accepted)
+            return (jnp.where(ok, a_try, alpha), accepted | ok), None
+
+        (alpha, got), _ = lax.scan(ls, (jnp.asarray(0.0, dtype), jnp.asarray(False)), jnp.arange(20))
+        x_new = x + jnp.where(got, alpha, 1e-4) * dx
+        return (x_new, it + 1, jnp.linalg.norm(Ffun(x_new), ord=jnp.inf))
+
+    def cond(carry):
+        _, it, res = carry
+        return (res > tol) & (it < max_iter)
+
+    x0r = x0
+    r0 = jnp.linalg.norm(Ffun(x0r), ord=jnp.inf)
+    xF, itF, resF = lax.while_loop(cond, body, (x0r, jnp.asarray(0, jnp.int32), r0))
+    zeros = jnp.zeros((0,), dtype)
+    return NLPSolution(
+        x=xF,
+        y=zeros,
+        zl=jnp.zeros_like(xF),
+        zu=jnp.zeros_like(xF),
+        obj=jnp.asarray(0.0, dtype),
+        converged=resF <= tol,
+        iterations=itF,
+        kkt_error=resF,
+    )
+
+
+def solve_nlp_batch(f_obj, c_eq, x0_batch, l, u, params_batch=None, **kw):
+    """vmap of `solve_nlp` over a leading scenario axis (the DP analogue,
+    SURVEY.md §2.7): one compiled kernel, all scenarios in flight."""
+    fn = lambda x0, p: solve_nlp(f_obj, c_eq, x0, l, u, p, **kw)
+    return jax.vmap(fn)(x0_batch, params_batch)

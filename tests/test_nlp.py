@@ -1,0 +1,116 @@
+"""NLP solver tests: classic problems with known solutions, a vmapped
+scenario batch, and a square 'flowsheet initialization' solve — the role
+IPOPT plays in the reference (SURVEY.md §2.6, §3.3)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu.solvers.nlp import solve_nlp, solve_nlp_batch, solve_square
+
+INF = jnp.inf
+
+
+def test_unconstrained_rosenbrock():
+    f = lambda x, p: (1 - x[0]) ** 2 + 100 * (x[1] - x[0] ** 2) ** 2
+    c = lambda x, p: jnp.zeros((0,))
+    sol = solve_nlp(f, c, jnp.array([-1.2, 1.0]), -INF, INF, tol=1e-8, max_iter=200)
+    assert bool(sol.converged)
+    np.testing.assert_allclose(np.asarray(sol.x), [1.0, 1.0], atol=1e-5)
+
+
+def test_hs006_equality_constrained():
+    # Hock-Schittkowski #6: min (1-x1)^2 s.t. 10(x2 - x1^2) = 0; x* = (1,1)
+    f = lambda x, p: (1 - x[0]) ** 2
+    c = lambda x, p: jnp.array([10.0 * (x[1] - x[0] ** 2)])
+    sol = solve_nlp(f, c, jnp.array([-1.2, 1.0]), -INF, INF, tol=1e-8, max_iter=200)
+    assert bool(sol.converged)
+    np.testing.assert_allclose(np.asarray(sol.x), [1.0, 1.0], atol=1e-5)
+
+
+def test_bounds_active_at_solution():
+    # min (x-2)^2 with x <= 1  ->  x* = 1, bound active, dual = 2
+    f = lambda x, p: (x[0] - 2.0) ** 2
+    c = lambda x, p: jnp.zeros((0,))
+    sol = solve_nlp(f, c, jnp.array([0.0]), jnp.array([-INF]), jnp.array([1.0]),
+                    tol=1e-8, max_iter=100)
+    assert bool(sol.converged)
+    assert float(sol.x[0]) == pytest.approx(1.0, abs=1e-6)
+    assert float(sol.zu[0]) == pytest.approx(2.0, abs=1e-4)
+
+
+def test_hs071_style_with_param():
+    # min x1*x4*(x1+x2+x3)+x3  s.t. x1^2+x2^2+x3^2+x4^2 = 40, 1<=x<=5
+    # (inequality x1*x2*x3*x4 >= 25 of the original HS71 handled as equality
+    #  with a bounded slack variable x5 in [25, inf))
+    def f(x, p):
+        return x[0] * x[3] * (x[0] + x[1] + x[2]) + x[2]
+
+    def c(x, p):
+        return jnp.array(
+            [
+                x[0] ** 2 + x[1] ** 2 + x[2] ** 2 + x[3] ** 2 - 40.0,
+                x[0] * x[1] * x[2] * x[3] - x[4],
+            ]
+        )
+
+    l = jnp.array([1.0, 1.0, 1.0, 1.0, 25.0])
+    u = jnp.array([5.0, 5.0, 5.0, 5.0, INF])
+    x0 = jnp.array([1.0, 5.0, 5.0, 1.0, 25.0])
+    sol = solve_nlp(f, c, x0, l, u, tol=1e-8, max_iter=300)
+    assert bool(sol.converged)
+    # known optimum of HS71
+    assert float(sol.obj) == pytest.approx(17.0140173, abs=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(sol.x[:4]), [1.0, 4.7429994, 3.8211503, 1.3794082], atol=1e-3
+    )
+
+
+def test_batched_quadratics_vmap():
+    # min (x - t)^2 over scenarios t: solution x = clip(t, 0, 2)
+    f = lambda x, p: jnp.sum((x - p) ** 2)
+    c = lambda x, p: jnp.zeros((0,))
+    ts = jnp.array([[-1.0], [0.5], [3.0]])
+    x0 = jnp.zeros((3, 1))
+    sols = solve_nlp_batch(f, c, x0, jnp.array([0.0]), jnp.array([2.0]),
+                           params_batch=ts, tol=1e-8, max_iter=60)
+    assert bool(jnp.all(sols.converged))
+    np.testing.assert_allclose(np.asarray(sols.x[:, 0]), [0.0, 0.5, 2.0], atol=1e-5)
+
+
+def test_square_solve_mass_energy_balance():
+    # toy 'flowsheet init': 2 streams mix; unknowns (n_out, T_out)
+    #   n_out = n1 + n2;  n_out*cp*T_out = n1*cp*T1 + n2*cp*T2
+    def F(x, p):
+        n1, T1, n2, T2 = p
+        return jnp.array(
+            [x[0] - (n1 + n2), x[0] * x[1] - (n1 * T1 + n2 * T2)]
+        )
+
+    p = jnp.array([2.0, 300.0, 1.0, 450.0])
+    sol = solve_square(F, jnp.array([1.0, 350.0]), p)
+    assert bool(sol.converged)
+    assert float(sol.x[0]) == pytest.approx(3.0, abs=1e-8)
+    assert float(sol.x[1]) == pytest.approx((2 * 300 + 450) / 3, abs=1e-6)
+
+
+def test_square_solve_newton_damping():
+    # strongly nonlinear scalar: exp(x) = 2 from a far start needs damping
+    F = lambda x, p: jnp.array([jnp.exp(x[0]) - 2.0])
+    sol = solve_square(F, jnp.array([10.0]), None, max_iter=100)
+    assert bool(sol.converged)
+    assert float(sol.x[0]) == pytest.approx(np.log(2.0), abs=1e-8)
+
+
+def test_fixed_variable_equal_bounds():
+    # fix-DoF idiom: x0 pinned by l==u must not poison the solve with NaN
+    f = lambda x, p: (x[1] - 3.0) ** 2 + x[0] * x[1]
+    c = lambda x, p: jnp.zeros((0,))
+    sol = solve_nlp(
+        f, c, jnp.array([1.0, 0.0]),
+        jnp.array([1.0, -INF]), jnp.array([1.0, INF]),
+        tol=1e-8, max_iter=100,
+    )
+    assert bool(sol.converged)
+    assert float(sol.x[0]) == pytest.approx(1.0, abs=1e-6)
+    assert float(sol.x[1]) == pytest.approx(2.5, abs=1e-5)  # argmin of (y-3)^2 + y
