@@ -300,9 +300,10 @@ def solve_square(
     """Damped Newton for a square system F(x, p) = 0 (n equations, n vars).
 
     The analogue of the reference's zero-degree-of-freedom flowsheet solves
-    (IPOPT square solve after `fix_dof_and_initialize`, SURVEY.md §3.3), with
-    a Levenberg-style fallback: steps use (J^T J + lambda I) when plain
-    Newton diverges — and halved steps if the residual norm does not drop.
+    (IPOPT square solve after `fix_dof_and_initialize`, SURVEY.md §3.3).
+    Steps solve (J + damping*I) dx = -r with a halving line search on the
+    residual norm; a non-finite direction falls back to a small
+    steepest-descent step on ||F||^2.
     """
     dtype = x0.dtype
     n = x0.shape[0]
@@ -310,8 +311,7 @@ def solve_square(
     Jfun = jax.jacfwd(Ffun)
 
     def body(carry):
-        x, it, _ = carry
-        r = Ffun(x)
+        x, it, r, _ = carry
         J = Jfun(x)
         dx = jnp.linalg.solve(J + damping * jnp.eye(n, dtype=dtype), -r)
         dx = jnp.where(jnp.all(jnp.isfinite(dx)), dx, -J.T @ r * 1e-6)
@@ -326,15 +326,18 @@ def solve_square(
 
         (alpha, got), _ = lax.scan(ls, (jnp.asarray(0.0, dtype), jnp.asarray(False)), jnp.arange(20))
         x_new = x + jnp.where(got, alpha, 1e-4) * dx
-        return (x_new, it + 1, jnp.linalg.norm(Ffun(x_new), ord=jnp.inf))
+        r_new = Ffun(x_new)
+        return (x_new, it + 1, r_new, jnp.linalg.norm(r_new, ord=jnp.inf))
 
     def cond(carry):
-        _, it, res = carry
+        _, it, _, res = carry
         return (res > tol) & (it < max_iter)
 
     x0r = x0
-    r0 = jnp.linalg.norm(Ffun(x0r), ord=jnp.inf)
-    xF, itF, resF = lax.while_loop(cond, body, (x0r, jnp.asarray(0, jnp.int32), r0))
+    r0 = Ffun(x0r)
+    xF, itF, _, resF = lax.while_loop(
+        cond, body, (x0r, jnp.asarray(0, jnp.int32), r0, jnp.linalg.norm(r0, ord=jnp.inf))
+    )
     zeros = jnp.zeros((0,), dtype)
     return NLPSolution(
         x=xF,

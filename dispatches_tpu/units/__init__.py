@@ -5,5 +5,6 @@ from .battery import BatteryStorage
 from .pem import PEMElectrolyzer
 from .splitter import ElectricalSplitter
 from .tank import SimpleHydrogenTank
+from .tank_detailed import HydrogenTankDetailed, TankState, tank_step, tank_volume
 from .turbine import HydrogenTurbine
 from .wind import SolarPV, WindPower

@@ -1,0 +1,163 @@
+"""Detailed compressed-gas hydrogen tank — nonlinear adiabatic dynamics.
+
+TPU-native redesign of the reference's `HydrogenTank`
+(`dispatches/unit_models/hydrogen_tank.py:68-622`): there, a ControlVolume0D
+with a `previous_state` StateBlock carries (P, T) between periods and IPOPT
+solves the coupled material/energy holdup equations. Here the same physics —
+ideal-gas holdup, adiabatic internal-energy balance, cylinder geometry — is a
+*closed-form differentiable state transition*:
+
+    n      = n_prev + dt * (flow_in - flow_out)          (material_balances
+                                                          + holdup integration,
+                                                          hydrogen_tank.py:321-343)
+    n u(T) = n_prev u(T_prev) + dt * (flow_in h(T_in)
+                                      - flow_out h(T))   (energy_balances,
+                                                          hydrogen_tank.py:395-409;
+                                                          outlet leaves at tank T)
+    P      = n R T / V                                   (ideal-gas holdup calc,
+                                                          hydrogen_tank.py:345-355)
+
+with u(T) = h(T) - R (T - T_ref), the IDAES ideal-gas internal-energy
+convention (u and h share the 298.15 K reference zero). The scalar energy
+balance is solved for T by a fixed-iteration Newton loop, so a whole horizon
+is one `lax.scan` and gradients flow through every step — no per-period NLP,
+no previous_state block, no subprocess.
+
+Validated against the reference's golden fill/empty numbers
+(`unit_models/tests/test_hydrogen_tank.py:148-185`): fill at 1 mol/s for 1 h
+into a 0.1 m x 0.3 m tank from (1e5 Pa, 300 K) -> holdup 3600.0945 mol,
+T ~ 300.75 K, P ~ 3.82e9 Pa.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..properties.h2 import R_GAS, SPECIES, T_REF, cp_mol, enth_mol
+
+_H2 = SPECIES.index("hydrogen")
+
+
+def tank_volume(diameter, length):
+    """Cylinder volume [m^3] (`hydrogen_tank.py:183-187` volume_cons)."""
+    return math.pi * length * (diameter / 2.0) ** 2
+
+
+def _h_h2(T):
+    """Pure-H2 molar enthalpy above 298.15 K [J/mol]."""
+    return enth_mol(T)[..., _H2]
+
+
+def _cp_h2(T):
+    return cp_mol(T)[..., _H2]
+
+
+def u_mol(T):
+    """Ideal-gas molar internal energy [J/mol], IDAES convention
+    u(T) = h(T) - R (T - T_ref) so that u(T_ref) = h(T_ref) = 0."""
+    return _h_h2(T) - R_GAS * (jnp.asarray(T) - T_REF)
+
+
+class TankState(NamedTuple):
+    holdup_mol: jnp.ndarray  # total H2 holdup [mol]
+    temperature: jnp.ndarray  # tank temperature [K]
+    pressure: jnp.ndarray  # tank pressure [Pa]
+
+
+def state_from_pt(pressure, temperature, volume):
+    """Tank state from (P, T) — the reference's `previous_state` fix idiom
+    (`test_hydrogen_tank.py:88-90`)."""
+    P = jnp.asarray(pressure, jnp.result_type(float))
+    T = jnp.asarray(temperature, jnp.result_type(float))
+    n = P * volume / (R_GAS * T)
+    return TankState(holdup_mol=n, temperature=T, pressure=P)
+
+
+def tank_step(
+    state: TankState,
+    flow_in_mol,  # mol/s
+    T_in,  # K
+    flow_out_mol,  # mol/s
+    dt,  # s
+    volume,  # m^3
+    newton_iters: int = 20,
+) -> TankState:
+    """One adiabatic fill/empty step. Differentiable; vmap/scan friendly."""
+    n_prev = state.holdup_mol
+    T_prev = state.temperature
+    fin = jnp.asarray(flow_in_mol, n_prev.dtype)
+    fout = jnp.asarray(flow_out_mol, n_prev.dtype)
+
+    # overdraw guard: the reference enforces holdup >= 0 through NLP variable
+    # bounds (`hydrogen_tank.py:248` within=NonNegativeReals); the closed-form
+    # transition enforces the same invariant by capping the outflow at what
+    # the tank actually contains (keeps T-Newton and gradients finite)
+    n_floor = 1e-9
+    fout = jnp.minimum(fout, jnp.maximum(n_prev + dt * fin - n_floor, 0.0) / dt)
+
+    n = n_prev + dt * (fin - fout)
+    # energy balance residual in T (outlet stream leaves at tank temperature,
+    # so the h(T)-dependent outflow term stays inside the Newton solve)
+    rhs_const = n_prev * u_mol(T_prev) + dt * fin * _h_h2(T_in)
+
+    def res(T):
+        return n * u_mol(T) + dt * fout * _h_h2(T) - rhs_const
+
+    T = T_prev
+    for _ in range(newton_iters):
+        # d/dT [n u + dt fout h] = n (cp - R) + dt fout cp
+        dres = n * (_cp_h2(T) - R_GAS) + dt * fout * _cp_h2(T)
+        T = jnp.clip(T - res(T) / dres, 150.0, 2000.0)
+
+    P = n * R_GAS * T / volume
+    return TankState(holdup_mol=n, temperature=T, pressure=P)
+
+
+class HydrogenTankDetailed:
+    """Horizon-level wrapper: scans `tank_step` over hourly (or finer)
+    in/out flow profiles. The analogue of chaining reference tank blocks
+    through `previous_state` across multiperiod blocks."""
+
+    def __init__(
+        self,
+        tank_diameter: float = 0.1,
+        tank_length: float = 0.3,
+        dt: float = 3600.0,
+        newton_iters: int = 20,
+    ):
+        self.volume = tank_volume(tank_diameter, tank_length)
+        self.dt = dt
+        self.newton_iters = newton_iters
+
+    def initial_state(self, pressure=1e5, temperature=300.0) -> TankState:
+        return state_from_pt(pressure, temperature, self.volume)
+
+    def step(self, state, flow_in_mol, T_in, flow_out_mol) -> TankState:
+        return tank_step(
+            state,
+            flow_in_mol,
+            T_in,
+            flow_out_mol,
+            self.dt,
+            self.volume,
+            self.newton_iters,
+        )
+
+    def simulate(self, state0: TankState, flow_in_mol, T_in, flow_out_mol):
+        """Run the whole horizon: arrays of shape (T,) -> TankState of
+        shape-(T,) leaves. One `lax.scan`, jit-compatible."""
+        fin = jnp.asarray(flow_in_mol)
+        tin = jnp.broadcast_to(jnp.asarray(T_in, fin.dtype), fin.shape)
+        fout = jnp.broadcast_to(jnp.asarray(flow_out_mol, fin.dtype), fin.shape)
+
+        def body(st, xs):
+            f_i, t_i, f_o = xs
+            new = self.step(st, f_i, t_i, f_o)
+            return new, new
+
+        _, traj = lax.scan(body, state0, (fin, tin, fout))
+        return traj

@@ -1,0 +1,68 @@
+"""Detailed nonlinear hydrogen tank vs the reference's golden fill/empty
+numbers (`dispatches/unit_models/tests/test_hydrogen_tank.py:148-185`)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu.units.tank_detailed import (
+    HydrogenTankDetailed,
+    tank_volume,
+)
+
+R = 8.31446261815324
+
+
+@pytest.fixture(scope="module")
+def tank():
+    return HydrogenTankDetailed(tank_diameter=0.1, tank_length=0.3, dt=3600.0)
+
+
+def test_volume():
+    assert tank_volume(0.1, 0.3) == pytest.approx(np.pi * 0.3 * 0.05**2)
+
+
+def test_fill_golden(tank):
+    """1 mol/s in, 0 out, 1 h from (1e5 Pa, 300 K): reference IPOPT solution
+    holdup=3600.0945 mol, T=300.749 K, P=3.820683e9 Pa."""
+    st0 = tank.initial_state(pressure=1e5, temperature=300.0)
+    assert float(st0.holdup_mol) == pytest.approx(0.0945, rel=1e-3)
+    st = tank.step(st0, flow_in_mol=1.0, T_in=300.0, flow_out_mol=0.0)
+    assert float(st.holdup_mol) == pytest.approx(3600.0945, rel=1e-6)
+    assert float(st.temperature) == pytest.approx(300.749, abs=0.2)
+    assert float(st.pressure) == pytest.approx(3820683416.0, rel=1e-2)
+    # density parity: 1527927.5 mol/m^3
+    assert float(st.holdup_mol) / tank.volume == pytest.approx(1527927.5, rel=1e-3)
+
+
+def test_empty_golden(tank):
+    """Same fill but 0.9 mol/s out: holdup=360.0945, T=300.055, P=3.8128e8."""
+    st0 = tank.initial_state(pressure=1e5, temperature=300.0)
+    st = tank.step(st0, flow_in_mol=1.0, T_in=300.0, flow_out_mol=0.9)
+    assert float(st.holdup_mol) == pytest.approx(360.0945, rel=1e-6)
+    assert float(st.temperature) == pytest.approx(300.055, abs=0.2)
+    assert float(st.pressure) == pytest.approx(381276652.0, rel=1e-2)
+
+
+def test_scan_horizon_mass_conservation(tank):
+    st0 = tank.initial_state()
+    fin = jnp.array([1.0, 0.5, 0.0, 0.0])
+    fout = jnp.array([0.0, 0.0, 0.3, 0.2])
+    traj = tank.simulate(st0, fin, 300.0, fout)
+    expect = float(st0.holdup_mol) + 3600.0 * float(jnp.sum(fin - fout))
+    assert float(traj.holdup_mol[-1]) == pytest.approx(expect, rel=1e-7)
+    # adiabatic fill heats, discharge relaxes back toward inlet T
+    assert float(traj.temperature[0]) > 300.0
+
+
+def test_differentiable_and_jittable(tank):
+    @jax.jit
+    def final_pressure(flow_in):
+        st0 = tank.initial_state()
+        traj = tank.simulate(st0, flow_in, 300.0, jnp.zeros_like(flow_in))
+        return traj.pressure[-1]
+
+    fin = jnp.full((6,), 0.5)
+    g = jax.grad(final_pressure)(fin)
+    # more inflow in any hour -> strictly higher final pressure
+    assert np.all(np.asarray(g) > 0.0)
