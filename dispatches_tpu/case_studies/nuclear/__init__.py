@@ -1,0 +1,12 @@
+"""Nuclear case study — NPP + PEM + H2 tank + H2 turbine hybrids
+(the L3-L5 analogue of `dispatches/case_studies/nuclear_case/`)."""
+
+from .flowsheet import NuclearFlowsheetResult, solve_ne_flowsheet
+from .multiperiod import MultiPeriodNuclear
+from .pricetaker import (
+    NuclearPricetakerConfig,
+    build_nuclear_pricetaker,
+    run_exhaustive_enumeration,
+    run_price_taker,
+    settlement_prices,
+)

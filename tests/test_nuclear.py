@@ -1,0 +1,191 @@
+"""Nuclear case study vs reference goldens
+(`nuclear_case/tests/test_nuclear_flowsheet.py:100-175`) and the report
+price-taker semantics (`report/price_taker_analysis.py`)."""
+import numpy as np
+import pytest
+
+from dispatches_tpu.case_studies.nuclear import (
+    MultiPeriodNuclear,
+    NuclearPricetakerConfig,
+    build_nuclear_pricetaker,
+    run_price_taker,
+    settlement_prices,
+    solve_ne_flowsheet,
+)
+from dispatches_tpu.case_studies.nuclear.pricetaker import (
+    H2_PROD_RATE,
+    NP_CAPACITY_MW,
+    _params,
+)
+from dispatches_tpu.market.tracker import Tracker
+from dispatches_tpu.solvers.ipm import solve_lp
+
+
+# ---------------------------------------------------------------- flowsheet
+class TestFlowsheet:
+    def test_npp_pem_golden(self):
+        """200 MW to PEM -> 505.481 mol/s H2 (`test_nuclear_flowsheet.py:100-112`
+        with electricity_to_mol=0.002527406)."""
+        res = solve_ne_flowsheet(
+            np_capacity_mw=500.0,
+            split_frac_grid=0.6,
+            include_tank=False,
+            include_turbine=False,
+        )
+        assert float(res.pem_out_mol) == pytest.approx(505.481, rel=1e-4)
+        assert float(res.np_to_grid_kw) == pytest.approx(300e3)
+
+    def test_npp_pem_tank_golden(self):
+        """Holdup after 1 h with pipeline draw 10 mol/s, no turbine:
+        1,747,732.32 + 36,000 mol (`test_nuclear_flowsheet.py:125-131`)."""
+        res = solve_ne_flowsheet(
+            np_capacity_mw=500.0,
+            split_frac_grid=0.6,
+            include_turbine=False,
+            flow_mol_to_pipeline=10.0,
+            flow_mol_to_turbine=0.0,
+        )
+        assert float(res.tank_holdup_mol) == pytest.approx(
+            1747732.3199 + 36000, rel=1e-4
+        )
+
+    def test_npp_pem_tank_turbine_golden(self):
+        """With 10 mol/s to the turbine too: holdup 1,747,732.32 mol;
+        compressor outlet ~793.42 K (`test_nuclear_flowsheet.py:133-175`)."""
+        res = solve_ne_flowsheet(
+            np_capacity_mw=500.0,
+            split_frac_grid=0.6,
+            flow_mol_to_pipeline=10.0,
+            flow_mol_to_turbine=10.0,
+        )
+        assert float(res.tank_holdup_mol) == pytest.approx(1747732.3199, rel=1e-4)
+        assert float(res.turbine.T_comp_out) == pytest.approx(793.42, rel=2e-2)
+        # combustion products: H2 nearly gone, N2 dominates
+        n_out = np.asarray(res.turbine.n_out)
+        y = n_out / n_out.sum()
+        assert y[0] == pytest.approx(0.00088043, abs=5e-4)  # hydrogen
+        assert y[2] == pytest.approx(0.73278, rel=2e-2)  # nitrogen
+        assert y[1] == pytest.approx(0.15276, rel=5e-2)  # oxygen
+
+    def test_differentiable_in_split(self):
+        import jax
+
+        g = jax.grad(
+            lambda s: solve_ne_flowsheet(
+                split_frac_grid=s, include_turbine=False
+            ).tank_holdup_mol
+        )(0.6)
+        # more grid share -> less PEM -> less holdup
+        assert float(g) < 0.0
+
+
+# ---------------------------------------------------------------- pricetaker
+def _lmps(T, seed=0):
+    rng = np.random.default_rng(seed)
+    da = 20.0 + 15.0 * rng.random(T)
+    rt = da + rng.normal(0, 5.0, T)
+    return da, np.maximum(rt, 0.0)
+
+
+class TestPricetaker:
+    T = 48
+
+    def test_settlement_prices(self):
+        da, rt = _lmps(24)
+        assert np.allclose(settlement_prices("DA", da, rt), da)
+        assert np.allclose(settlement_prices("RT", da, rt), rt)
+        mx = settlement_prices("Max-DA-RT", da, rt)
+        assert np.all(mx >= da) and np.all(mx >= rt)
+
+    def test_power_balance_and_capacity(self):
+        cfg = NuclearPricetakerConfig(T=self.T, pem_capacity_mw=100.0)
+        da, rt = _lmps(self.T)
+        prog, sol, p = run_price_taker(cfg, da, rt, h2_price=2.0, market="DA")
+        assert bool(sol.converged)
+        to_grid = np.asarray(prog.eval_expr("np_to_grid", sol.x, p))
+        to_pem = np.asarray(prog.eval_expr("np_to_electrolyzer", sol.x, p))
+        assert np.allclose(to_grid + to_pem, NP_CAPACITY_MW, atol=1e-4)
+        assert np.all(to_pem <= 100.0 + 1e-5)
+
+    def test_high_h2_price_runs_pem_at_capacity(self):
+        """When H2 revenue per MWh (price*20 kg/MWh) far exceeds LMP, the
+        optimizer should run the electrolyzer flat out."""
+        cfg = NuclearPricetakerConfig(T=self.T, pem_capacity_mw=50.0)
+        da, rt = _lmps(self.T)
+        prog, sol, p = run_price_taker(cfg, da, rt, h2_price=10.0, market="DA")
+        to_pem = np.asarray(prog.eval_expr("np_to_electrolyzer", sol.x, p))
+        assert np.allclose(to_pem, 50.0, atol=1e-3)
+
+    def test_zero_h2_price_sells_all_power(self):
+        cfg = NuclearPricetakerConfig(T=self.T, pem_capacity_mw=50.0)
+        da, rt = _lmps(self.T)
+        prog, sol, p = run_price_taker(cfg, da, rt, h2_price=0.0, market="DA")
+        to_pem = np.asarray(prog.eval_expr("np_to_electrolyzer", sol.x, p))
+        assert np.allclose(to_pem, 0.0, atol=1e-3)
+
+    def test_max_variant_dominates(self):
+        """Objective under max(DA,RT) prices >= objective under DA or RT."""
+        cfg = NuclearPricetakerConfig(T=self.T, pem_capacity_mw=100.0)
+        da, rt = _lmps(self.T)
+        objs = {}
+        for mk in ("DA", "RT", "Max-DA-RT"):
+            prog, sol, p = run_price_taker(cfg, da, rt, h2_price=1.0, market=mk)
+            objs[mk] = float(prog.eval_expr("annualized_npv", sol.x, p))
+        assert objs["Max-DA-RT"] >= objs["DA"] - 1e-3
+        assert objs["Max-DA-RT"] >= objs["RT"] - 1e-3
+
+    def test_two_step_settlement(self):
+        """V4: step-2 revenue settles DA position at DA prices plus RT
+        deviations; with rt == da it must equal the V1 revenue."""
+        cfg = NuclearPricetakerConfig(T=self.T, pem_capacity_mw=100.0)
+        da, _ = _lmps(self.T)
+        prog, sol_v1, p1 = run_price_taker(cfg, da, da, h2_price=1.0, market="DA")
+        prog2, sol_v4, p4 = run_price_taker(cfg, da, da, h2_price=1.0, market="DA-RT")
+        r1 = float(prog.eval_expr("electricity_revenue", sol_v1.x, p1))
+        r4 = float(prog2.eval_expr("electricity_revenue", sol_v4.x, p4))
+        assert r4 == pytest.approx(r1, rel=1e-5)
+
+
+# ---------------------------------------------------------------- double loop
+class TestMultiPeriodNuclear:
+    def test_tracker_follows_dispatch(self):
+        """Scripted-dispatch tracking, the reference test pattern
+        (`test_multiperiod_wind_battery_doubleloop.py:41-110`): NPP+PEM can
+        serve any signal in [np-pem_cap, np] MW exactly."""
+        mp = MultiPeriodNuclear(
+            np_capacity_mw=500.0, pem_capacity_mw=100.0, tank_capacity_kg=5000.0
+        )
+        tracker = Tracker(mp, tracking_horizon=4, n_tracking_hour=1)
+        dispatch = [480.0, 450.0, 400.0, 500.0]
+        tracker.track_market_dispatch(dispatch, 0, 0)
+        power = tracker.power_output
+        assert np.allclose(power, dispatch, atol=1e-2)
+        # tank holdup advanced: 20 MW * 20 kg/MWh = 400 kg produced in hour 0
+        # (unless sold to pipeline — either way state is nonnegative)
+        assert mp.state["holdup0"] >= -1e-6
+
+    def test_tank_capacity_limits_flexibility(self):
+        mp = MultiPeriodNuclear(
+            np_capacity_mw=500.0, pem_capacity_mw=100.0, tank_capacity_kg=5000.0
+        )
+        tracker = Tracker(mp, tracking_horizon=3, n_tracking_hour=1)
+        # 400 MW for 3 h wants 100 MW into PEM = 2000 kg/hr -> pipeline+tank
+        tracker.track_market_dispatch([400.0, 400.0, 400.0], 0, 0)
+        holdup = tracker.extract("tank_holdup")
+        assert np.all(holdup <= 5000.0 + 1e-6)
+
+
+def test_exhaustive_enumeration_batched():
+    """The report's (h2_price x pem_capacity) grid as one vmapped solve:
+    high H2 price -> cap factor ~1, low -> ~0."""
+    from dispatches_tpu.case_studies.nuclear import run_exhaustive_enumeration
+
+    rng = np.random.default_rng(1)
+    T = 48
+    da = 20 + 15 * rng.random(T)
+    rt = np.maximum(da + rng.normal(0, 5, T), 0)
+    out = run_exhaustive_enumeration(
+        da, rt, h2_prices=(1.0, 2.0), pem_fracs=(0.1, 0.3), T=T
+    )
+    assert out["pem_cap_factor"]["10"] == pytest.approx(1.0, abs=1e-3)
+    assert out["pem_cap_factor"]["00"] == pytest.approx(0.0, abs=1e-3)
