@@ -1,0 +1,278 @@
+"""Steam/water thermodynamics — IAPWS-IF97 regions 1, 2 and 4 in pure JAX.
+
+The reference's steam-cycle cases lean on IDAES's compiled Helmholtz
+`iapws95` property package (used by `simple_rankine_cycle.py`,
+`ultra_supercritical_powerplant.py`, `concrete_tes.py` via
+`HelmholtzParameterBlock`). The TPU-native replacement is the IAPWS
+Industrial Formulation 1997: Gibbs-energy polynomial forms whose
+coefficients are public standard data, evaluated as fixed-shape tensor
+contractions — differentiable, jit/vmap-compatible, no external binary.
+
+Coverage:
+  region 1 — compressed liquid, 273.15 K <= T <= 623.15 K, P <= 100 MPa
+  region 2 — superheated vapor up to 1073.15 K, P <= 100 MPa (incl. USC
+             main/reheat steam: 24 MPa / 866 K lies in region 2)
+  region 4 — saturation curve (exact quadratic solution both directions)
+
+Units: P in Pa, T in K, mass-specific results in J/kg (/K). All property
+functions accept broadcasting array arguments.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+R_WATER = 461.526  # J/kg/K
+T_CRIT = 647.096  # K
+P_CRIT = 22.064e6  # Pa
+
+# ---------------------------------------------------------------- region 4
+_N4 = np.array(
+    [
+        0.11670521452767e4,
+        -0.72421316703206e6,
+        -0.17073846940092e2,
+        0.12020824702470e5,
+        -0.32325550322333e7,
+        0.14915108613530e2,
+        -0.48232657361591e4,
+        0.40511340542057e6,
+        -0.23855557567849,
+        0.65017534844798e3,
+    ]
+)
+
+
+def sat_pressure(T):
+    """Saturation pressure [Pa] for 273.15 K <= T <= 647.096 K."""
+    T = jnp.asarray(T, jnp.result_type(float))
+    n = _N4
+    theta = T + n[8] / (T - n[9])
+    A = theta**2 + n[0] * theta + n[1]
+    B = n[2] * theta**2 + n[3] * theta + n[4]
+    C = n[5] * theta**2 + n[6] * theta + n[7]
+    p_mpa = (2.0 * C / (-B + jnp.sqrt(B**2 - 4.0 * A * C))) ** 4
+    return p_mpa * 1e6
+
+
+def sat_temperature(P):
+    """Saturation temperature [K] for 611.213 Pa <= P <= 22.064 MPa."""
+    beta = (jnp.asarray(P, jnp.result_type(float)) / 1e6) ** 0.25
+    n = _N4
+    E = beta**2 + n[2] * beta + n[5]
+    F = n[0] * beta**2 + n[3] * beta + n[6]
+    G = n[1] * beta**2 + n[4] * beta + n[7]
+    D = 2.0 * G / (-F - jnp.sqrt(F**2 - 4.0 * E * G))
+    return 0.5 * (n[9] + D - jnp.sqrt((n[9] + D) ** 2 - 4.0 * (n[8] + n[9] * D)))
+
+
+# ---------------------------------------------------------------- region 1
+_I1 = np.array(
+    [0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1, 2, 2, 2, 2, 2, 3, 3, 3, 4, 4, 4,
+     5, 8, 8, 21, 23, 29, 30, 31, 32], dtype=float
+)
+_J1 = np.array(
+    [-2, -1, 0, 1, 2, 3, 4, 5, -9, -7, -1, 0, 1, 3, -3, 0, 1, 3, 17, -4, 0, 6,
+     -5, -2, 10, -8, -11, -6, -29, -31, -38, -39, -40, -41], dtype=float
+)
+_N1 = np.array(
+    [
+        0.14632971213167, -0.84548187169114, -0.37563603672040e1,
+        0.33855169168385e1, -0.95791963387872, 0.15772038513228,
+        -0.16616417199501e-1, 0.81214629983568e-3, 0.28319080123804e-3,
+        -0.60706301565874e-3, -0.18990068218419e-1, -0.32529748770505e-1,
+        -0.21841717175414e-1, -0.52838357969930e-4, -0.47184321073267e-3,
+        -0.30001780793026e-3, 0.47661393906987e-4, -0.44141845330846e-5,
+        -0.72694996297594e-15, -0.31679644845054e-4, -0.28270797985312e-5,
+        -0.85205128120103e-9, -0.22425281908000e-5, -0.65171222895601e-6,
+        -0.14341729937924e-12, -0.40516996860117e-6, -0.12734301741641e-8,
+        -0.17424871230634e-9, -0.68762131295531e-18, 0.14478307828521e-19,
+        0.26335781662795e-22, -0.11947622640071e-22, 0.18228094581404e-23,
+        -0.93537087292458e-25,
+    ]
+)
+
+
+class SteamProps(NamedTuple):
+    v: jnp.ndarray  # specific volume [m^3/kg]
+    h: jnp.ndarray  # specific enthalpy [J/kg]
+    s: jnp.ndarray  # specific entropy [J/kg/K]
+    u: jnp.ndarray  # specific internal energy [J/kg]
+    cp: jnp.ndarray  # isobaric heat capacity [J/kg/K]
+
+
+def props_liquid(P, T) -> SteamProps:
+    """Region-1 compressed-liquid properties from the Gibbs form
+    g/RT = sum n_i (7.1-pi)^I_i (tau-1.222)^J_i."""
+    P = jnp.asarray(P, jnp.result_type(float))
+    T = jnp.asarray(T, jnp.result_type(float))
+    pi = P / 16.53e6
+    tau = 1386.0 / T
+    a = (7.1 - pi)[..., None]
+    b = (tau - 1.222)[..., None]
+    terms = _N1 * a**_I1 * b**_J1
+    g = jnp.sum(terms, -1)
+    g_pi = jnp.sum(-_N1 * _I1 * a ** (_I1 - 1) * b**_J1, -1)
+    g_tau = jnp.sum(_N1 * a**_I1 * _J1 * b ** (_J1 - 1), -1)
+    g_tautau = jnp.sum(_N1 * a**_I1 * _J1 * (_J1 - 1) * b ** (_J1 - 2), -1)
+    RT = R_WATER * T
+    v = RT * pi * g_pi / P
+    h = RT * tau * g_tau
+    s = R_WATER * (tau * g_tau - g)
+    return SteamProps(v=v, h=h, s=s, u=h - P * v, cp=-R_WATER * tau**2 * g_tautau)
+
+
+# ---------------------------------------------------------------- region 2
+_J0_2 = np.array([0, 1, -5, -4, -3, -2, -1, 2, 3], dtype=float)
+_N0_2 = np.array(
+    [
+        -0.96927686500217e1, 0.10086655968018e2, -0.56087911283020e-2,
+        0.71452738081455e-1, -0.40710498223928, 0.14240819171444e1,
+        -0.43839511319450e1, -0.28408632460772, 0.21268463753307e-1,
+    ]
+)
+_I2 = np.array(
+    [1, 1, 1, 1, 1, 2, 2, 2, 2, 2, 3, 3, 3, 3, 3, 4, 4, 4, 5, 6, 6, 6, 7, 7, 7,
+     8, 8, 9, 10, 10, 10, 16, 16, 18, 20, 20, 20, 21, 22, 23, 24, 24, 24],
+    dtype=float,
+)
+_J2 = np.array(
+    [0, 1, 2, 3, 6, 1, 2, 4, 7, 36, 0, 1, 3, 6, 35, 1, 2, 3, 7, 3, 16, 35, 0,
+     11, 25, 8, 36, 13, 4, 10, 14, 29, 50, 57, 20, 35, 48, 21, 53, 39, 26, 40,
+     58],
+    dtype=float,
+)
+_N2 = np.array(
+    [
+        -0.17731742473213e-2, -0.17834862292358e-1, -0.45996013696365e-1,
+        -0.57581259083432e-1, -0.50325278727930e-1, -0.33032641670203e-4,
+        -0.18948987516315e-3, -0.39392777243355e-2, -0.43797295650573e-1,
+        -0.26674547914087e-4, 0.20481737692309e-7, 0.43870667284435e-6,
+        -0.32277677238570e-4, -0.15033924542148e-2, -0.40668253562649e-1,
+        -0.78847309559367e-9, 0.12790717852285e-7, 0.48225372718507e-6,
+        0.22922076337661e-5, -0.16714766451061e-10, -0.21171472321355e-2,
+        -0.23895741934104e2, -0.59059564324270e-17, -0.12621808899101e-5,
+        -0.38946842435739e-1, 0.11256211360459e-10, -0.82311340897998e1,
+        0.19809712802088e-7, 0.10406965210174e-18, -0.10234747095929e-12,
+        -0.10018179379511e-8, -0.80882908646985e-10, 0.10693031879409,
+        -0.33662250574171, 0.89185845355421e-24, 0.30629316876232e-12,
+        -0.42002467698208e-5, -0.59056029685639e-25, 0.37826947613457e-5,
+        -0.12768608934681e-14, 0.73087610595061e-28, 0.55414715350778e-16,
+        -0.94369707241210e-6,
+    ]
+)
+
+
+def props_vapor(P, T) -> SteamProps:
+    """Region-2 superheated-vapor properties, g/RT = gamma0 + gammar."""
+    P = jnp.asarray(P, jnp.result_type(float))
+    T = jnp.asarray(T, jnp.result_type(float))
+    pi = P / 1e6
+    tau = 540.0 / T
+    t = tau[..., None]
+    p = pi[..., None]
+
+    g0 = jnp.log(pi) + jnp.sum(_N0_2 * t**_J0_2, -1)
+    g0_pi = 1.0 / pi
+    g0_tau = jnp.sum(_N0_2 * _J0_2 * t ** (_J0_2 - 1), -1)
+    g0_tautau = jnp.sum(_N0_2 * _J0_2 * (_J0_2 - 1) * t ** (_J0_2 - 2), -1)
+
+    b = (tau - 0.5)[..., None]
+    gr = jnp.sum(_N2 * p**_I2 * b**_J2, -1)
+    gr_pi = jnp.sum(_N2 * _I2 * p ** (_I2 - 1) * b**_J2, -1)
+    gr_tau = jnp.sum(_N2 * p**_I2 * _J2 * b ** (_J2 - 1), -1)
+    gr_tautau = jnp.sum(_N2 * p**_I2 * _J2 * (_J2 - 1) * b ** (_J2 - 2), -1)
+
+    RT = R_WATER * T
+    v = RT * pi * (g0_pi + gr_pi) / P
+    h = RT * tau * (g0_tau + gr_tau)
+    s = R_WATER * (tau * (g0_tau + gr_tau) - (g0 + gr))
+    cp = -R_WATER * tau**2 * (g0_tautau + gr_tautau)
+    return SteamProps(v=v, h=h, s=s, u=h - P * v, cp=cp)
+
+
+# ------------------------------------------------------- saturation states
+def sat_liquid(P) -> SteamProps:
+    """Saturated-liquid state at pressure P (region 1 on the sat curve)."""
+    return props_liquid(P, sat_temperature(P))
+
+
+def sat_vapor(P) -> SteamProps:
+    """Saturated-vapor state at pressure P (region 2 on the sat curve)."""
+    return props_vapor(P, sat_temperature(P))
+
+
+# ------------------------------------------------------------- inversions
+def temperature_ph_vapor(P, h_target, T_guess=None, iters: int = 25):
+    """T with h_vapor(P, T) = h_target, fixed-iteration Newton."""
+    P = jnp.asarray(P, jnp.result_type(float))
+    h_target = jnp.asarray(h_target, jnp.result_type(float))
+    T = (
+        jnp.broadcast_to(jnp.asarray(T_guess, P.dtype), jnp.broadcast_shapes(P.shape, h_target.shape))
+        if T_guess is not None
+        else jnp.maximum(sat_temperature(P) + 10.0, 300.0)
+    )
+    for _ in range(iters):
+        pr = props_vapor(P, T)
+        T = jnp.clip(T - (pr.h - h_target) / pr.cp, 273.16, 2273.15)
+    return T
+
+
+def temperature_ps_vapor(P, s_target, iters: int = 25):
+    """T with s_vapor(P, T) = s_target (ds/dT = cp/T)."""
+    P = jnp.asarray(P, jnp.result_type(float))
+    s_target = jnp.asarray(s_target, jnp.result_type(float))
+    T = jnp.maximum(sat_temperature(P) + 10.0, 300.0)
+    T = jnp.broadcast_to(T, jnp.broadcast_shapes(P.shape, s_target.shape))
+    for _ in range(iters):
+        pr = props_vapor(P, T)
+        T = jnp.clip(T - (pr.s - s_target) * T / pr.cp, 273.16, 2273.15)
+    return T
+
+
+# ----------------------------------------------------- cycle building blocks
+class ExpansionResult(NamedTuple):
+    h_out: jnp.ndarray  # J/kg
+    T_out: jnp.ndarray  # K (saturation T if two-phase)
+    quality: jnp.ndarray  # vapor fraction in [0,1]; 1.0 if superheated
+    work: jnp.ndarray  # J/kg extracted (positive)
+
+
+def turbine_expansion(P_in, T_in, P_out, eta_isentropic=1.0) -> ExpansionResult:
+    """Expand superheated steam from (P_in, T_in) to P_out with isentropic
+    efficiency eta. Handles wet exhaust via region-4 quality mixing — the
+    IDAES HelmTurbineStage behavior (`simple_rankine_cycle.py:110-130`)."""
+    inlet = props_vapor(P_in, T_in)
+    s_in = inlet.s
+    Tsat = sat_temperature(P_out)
+    liq = props_liquid(P_out, Tsat)
+    vap = props_vapor(P_out, Tsat)
+    # isentropic endpoint: wet if s_in < s_g(P_out)
+    wet = s_in < vap.s
+    x_s = jnp.clip((s_in - liq.s) / jnp.maximum(vap.s - liq.s, 1e-9), 0.0, 1.0)
+    h_s_wet = liq.h + x_s * (vap.h - liq.h)
+    T_dry = temperature_ps_vapor(P_out, s_in)
+    h_s_dry = props_vapor(P_out, T_dry).h
+    h_s = jnp.where(wet, h_s_wet, h_s_dry)
+
+    h_out = inlet.h - eta_isentropic * (inlet.h - h_s)
+    # actual endpoint state at P_out
+    wet_act = h_out < vap.h
+    x = jnp.clip((h_out - liq.h) / jnp.maximum(vap.h - liq.h, 1e-9), 0.0, 1.0)
+    T_out = jnp.where(
+        wet_act, Tsat, temperature_ph_vapor(P_out, h_out, T_guess=jnp.maximum(T_dry, Tsat + 1.0))
+    )
+    return ExpansionResult(
+        h_out=h_out,
+        T_out=T_out,
+        quality=jnp.where(wet_act, x, jnp.ones_like(x)),
+        work=inlet.h - h_out,
+    )
+
+
+def pump_work(P_in, P_out, T_in, eta_isentropic=1.0):
+    """Feedwater pump specific work [J/kg]: v dP / eta (incompressible)."""
+    v = props_liquid(P_in, T_in).v
+    return v * (jnp.asarray(P_out) - jnp.asarray(P_in)) / eta_isentropic
