@@ -1,0 +1,15 @@
+"""Simple Rankine cycle case study
+(the analogue of `dispatches/case_studies/simple_rankine_cycle/`)."""
+
+from .flowsheet import (
+    RankineSpec,
+    RankineState,
+    capital_cost_musd,
+    solve_rankine,
+    specific_energies,
+)
+from .stochastic import (
+    StochasticResult,
+    stochastic_optimization_problem,
+    surrogate_design_problem,
+)

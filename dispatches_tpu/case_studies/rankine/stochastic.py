@@ -1,0 +1,186 @@
+"""Scenario-weighted price-taker design of the simple Rankine plant.
+
+TPU-native counterpart of `stochastic_optimization_problem`
+(`simple_rankine_cycle.py:605-778`): the reference instantiates one full
+IDAES flowsheet per LMP scenario (warm-started via `to_json`/`from_json`)
+plus a "capex plant", couples them with P_min/P_max constraints, and hands
+the resulting NLP to IPOPT. Here the whole problem is a single smooth
+box-constrained program:
+
+    x = [cap_flow, f_1 .. f_N],  f_i in [0.3, 1]  (op P in [0.3, 1]*P_max)
+    op_flow_i = f_i * cap_flow
+
+because with fixed intensive states every scenario flowsheet is the SAME
+closed-form function of its flow (see flowsheet.py) — the design/operation
+coupling constraints of the reference (`eq_min_power`/`eq_max_power`,
+`:680-688`) become variable bounds, and the scenario loop a vmap. Solved
+with the batched interior-point NLP solver; gradients via autodiff replace
+the reference's finite-difference-free but rebuild-heavy Pyomo path.
+
+Objective (`:750-764`): max plant_lifetime * sum_i w_i (lmp_i * P_i -
+opcost_i) - capital_payment_years * capex(cap_flow)/payment_years
+== min -(revenue - cost), identical algebra.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...solvers.nlp import solve_nlp
+from .flowsheet import RankineSpec, capital_cost_musd, solve_rankine, specific_energies
+
+MW_WATER = 0.01801528
+
+
+@dataclasses.dataclass
+class StochasticResult:
+    cap_flow_mol: float
+    p_max_mw: float
+    op_power_mw: np.ndarray
+    obj_usd: float
+    converged: bool
+    iterations: int
+
+
+def stochastic_optimization_problem(
+    lmp,
+    lmp_weights=None,
+    power_demand=None,
+    calc_boiler_eff: bool = False,
+    p_max_lower_bound: float = 10.0,  # MW
+    p_max_upper_bound: float = 300.0,
+    capital_payment_years: float = 5.0,
+    plant_lifetime: float = 20.0,
+    spec: RankineSpec = RankineSpec(),
+    min_power_frac: float = 0.3,
+    x0_flow: float = 10000.0,
+    tol: float = 1e-6,
+    max_iter: int = 150,
+) -> StochasticResult:
+    """Solve the stochastic design problem for LMP scenarios `lmp` [$/MWh]
+    with probabilities/durations `lmp_weights` (hours per scenario-year)."""
+    lmp = jnp.asarray(lmp, jnp.result_type(float))
+    N = lmp.shape[0]
+    w = (
+        jnp.ones(N, lmp.dtype) * (8760.0 / N)
+        if lmp_weights is None
+        else jnp.asarray(lmp_weights, lmp.dtype)
+    )
+    demand = None if power_demand is None else jnp.asarray(power_demand, lmp.dtype)
+
+    # specific net work [J/kg] is flow-independent: use it to convert the
+    # P_max bounds into capacity-flow bounds
+    se = specific_energies(spec)
+    w_net = float(se["w_net_specific"]) * MW_WATER  # W per (mol/s)
+    lb_flow = p_max_lower_bound * 1e6 / w_net
+    ub_flow = p_max_upper_bound * 1e6 / w_net
+
+    def objective(x, _p):
+        cap_flow = x[0]
+        f = x[1:]
+        op_flow = f * cap_flow
+        p_max = solve_rankine(cap_flow, spec).net_power_w
+        st = solve_rankine(
+            op_flow,
+            spec,
+            net_power_max_w=p_max,
+            calc_boiler_eff=calc_boiler_eff,
+        )
+        rev = jnp.sum(w * lmp * st.net_power_w * 1e-6)  # $/yr
+        op = jnp.sum(w * st.operating_cost_per_hr)  # $/yr
+        capex = capital_cost_musd(cap_flow, spec) * 1e6  # $
+        total_cost = plant_lifetime * op + capex
+        total_rev = plant_lifetime * rev
+        # penalize demand violation smoothly if a demand cap is given
+        pen = 0.0
+        if demand is not None:
+            over = jnp.maximum(st.net_power_w * 1e-6 - demand, 0.0)
+            pen = 1e9 * jnp.sum(over**2)
+        return -(total_rev - total_cost) * 1e-8 + pen * 1e-8  # scaled
+
+    n = 1 + N
+    x0 = jnp.concatenate(
+        [jnp.asarray([x0_flow]), jnp.full((N,), 0.9)]
+    ).astype(lmp.dtype)
+    l = jnp.concatenate([jnp.asarray([lb_flow]), jnp.full((N,), min_power_frac)])
+    u = jnp.concatenate([jnp.asarray([ub_flow]), jnp.ones((N,))])
+
+    c_eq = lambda x, p: jnp.zeros((0,), x.dtype)
+    sol = solve_nlp(
+        objective, c_eq, x0, l.astype(lmp.dtype), u.astype(lmp.dtype),
+        tol=tol, max_iter=max_iter,
+    )
+
+    cap_flow = float(sol.x[0])
+    f = np.asarray(sol.x[1:])
+    p_max = float(solve_rankine(cap_flow, spec).net_power_w) * 1e-6
+    op_power = np.asarray(
+        solve_rankine(
+            jnp.asarray(f) * cap_flow,
+            spec,
+            net_power_max_w=p_max * 1e6,
+            calc_boiler_eff=calc_boiler_eff,
+        ).net_power_w
+    ) * 1e-6
+    return StochasticResult(
+        cap_flow_mol=cap_flow,
+        p_max_mw=p_max,
+        op_power_mw=op_power,
+        obj_usd=-float(sol.obj) * 1e8,
+        converged=bool(sol.converged),
+        iterations=int(sol.iterations),
+    )
+
+
+def surrogate_design_problem(
+    revenue_surrogate,
+    p_max_lower_bound: float = 10.0,
+    p_max_upper_bound: float = 300.0,
+    capital_payment_years: float = 5.0,
+    plant_lifetime: float = 20.0,
+    spec: RankineSpec = RankineSpec(),
+    tol: float = 1e-6,
+    max_iter: int = 100,
+):
+    """Conceptual design with an ML revenue surrogate in the loop — the
+    analogue of `surrogate_design_scikit.py:95-180`/`surrogate_design_alamo.py`,
+    where trained revenue/zone-hour surrogates are embedded via OMLT into a
+    Pyomo NLP. Here the surrogate is a Flax MLP (or any callable
+    p_max_mw -> $/yr) called directly inside the autodiff'd objective — no
+    LP/NLP encoding of the network needed.
+
+    `revenue_surrogate`: callable mapping shape-(1,) [p_max in MW] to
+    predicted annual revenue [$/yr] (e.g. `TrainedSurrogate.predict`)."""
+    se = specific_energies(spec)
+    w_net = float(se["w_net_specific"]) * MW_WATER
+    lb_flow = p_max_lower_bound * 1e6 / w_net
+    ub_flow = p_max_upper_bound * 1e6 / w_net
+
+    def objective(x, _p):
+        cap_flow = x[0]
+        p_max_mw = solve_rankine(cap_flow, spec).net_power_w * 1e-6
+        rev = revenue_surrogate(jnp.reshape(p_max_mw, (1,)))
+        rev = jnp.reshape(rev, ())
+        capex = capital_cost_musd(cap_flow, spec) * 1e6
+        return -(plant_lifetime * rev - capex) * 1e-8
+
+    x0 = jnp.asarray([0.5 * (lb_flow + ub_flow)])
+    sol = solve_nlp(
+        objective,
+        lambda x, p: jnp.zeros((0,), x.dtype),
+        x0,
+        jnp.asarray([lb_flow]),
+        jnp.asarray([ub_flow]),
+        tol=tol,
+        max_iter=max_iter,
+    )
+    cap_flow = float(sol.x[0])
+    return {
+        "cap_flow_mol": cap_flow,
+        "p_max_mw": float(solve_rankine(cap_flow, spec).net_power_w) * 1e-6,
+        "npv_usd": -float(sol.obj) * 1e8,
+        "converged": bool(sol.converged),
+    }

@@ -1,0 +1,101 @@
+"""Simple Rankine cycle case study
+(reference `simple_rankine_cycle.py` semantics)."""
+import numpy as np
+import pytest
+
+from dispatches_tpu.case_studies.rankine import (
+    RankineSpec,
+    capital_cost_musd,
+    solve_rankine,
+    specific_energies,
+    stochastic_optimization_problem,
+    surrogate_design_problem,
+)
+
+
+class TestFlowsheet:
+    @pytest.mark.parametrize("hr", [False, True])
+    def test_energy_balance_closes(self, hr):
+        """First law around the closed loop: Q_boiler + W_pump = W_turb -
+        Q_cond (condenser duty negative) — exactly, in both heat-recovery
+        configurations."""
+        st = solve_rankine(10000.0, RankineSpec(heat_recovery=hr))
+        lhs = float(st.boiler_duty_w + st.pump_work_w)
+        rhs = float(st.turbine_work_w - st.condenser_duty_w)
+        assert lhs == pytest.approx(rhs, rel=1e-9)
+
+    def test_heat_recovery_raises_efficiency(self):
+        base = solve_rankine(10000.0, RankineSpec(heat_recovery=False))
+        hr = solve_rankine(10000.0, RankineSpec(heat_recovery=True))
+        assert float(hr.cycle_efficiency_pct) > float(base.cycle_efficiency_pct)
+
+    def test_power_linear_in_flow(self):
+        """With fixed intensive states, net power is exactly linear in BFW
+        flow — the property the stochastic layer exploits."""
+        p1 = float(solve_rankine(5000.0).net_power_w)
+        p2 = float(solve_rankine(10000.0).net_power_w)
+        assert p2 == pytest.approx(2 * p1, rel=1e-12)
+
+    def test_magnitudes(self):
+        """10,000 mol/s BFW -> a ~90-120 MW net toy plant (the reference's
+        square problem sizes net_power ~100 MW at this flow scale)."""
+        st = solve_rankine(10000.0)
+        assert 60e6 < float(st.net_power_w) < 150e6
+        # the toy spec expands only to 2 MPa yet condenses to 311 K, so the
+        # closed-loop cycle is deliberately lossy (~15%)
+        assert 10.0 < float(st.cycle_efficiency_pct) < 35.0
+        # heat rate consistent with cycle efficiency: 3412/eff
+        eff = float(st.net_power_w / st.boiler_duty_w * st.boiler_eff)
+        assert float(st.heat_rate_btu_kwh) == pytest.approx(3412.14 / eff, rel=1e-3)
+
+    def test_boiler_eff_capacity_factor(self):
+        """calc_boiler_eff: eff = 0.2143 * cf + 0.7357 -> 0.95 at cf=1."""
+        st_full = solve_rankine(
+            10000.0,
+            net_power_max_w=float(solve_rankine(10000.0).net_power_w),
+            calc_boiler_eff=True,
+        )
+        assert float(st_full.boiler_eff) == pytest.approx(0.95, abs=1e-6)
+        p_max = float(solve_rankine(10000.0).net_power_w)
+        st_half = solve_rankine(5000.0, net_power_max_w=p_max, calc_boiler_eff=True)
+        assert float(st_half.boiler_eff) == pytest.approx(0.2143 * 0.5 + 0.7357, abs=1e-6)
+
+    def test_capex_scale_and_monotone(self):
+        c1 = float(capital_cost_musd(5000.0))
+        c2 = float(capital_cost_musd(10000.0))
+        assert 100.0 < c2 < 600.0  # $M, NETL-vintage scale for ~100 MW
+        assert c2 > c1
+        # economies of scale: cost less than linear in size
+        assert c2 < 2 * c1
+
+
+class TestStochasticDesign:
+    def test_unprofitable_prices_shrink_design(self):
+        rng = np.random.default_rng(0)
+        lmp = 15 + 20 * rng.random(6)
+        res = stochastic_optimization_problem(lmp, max_iter=120)
+        assert res.converged
+        assert res.p_max_mw == pytest.approx(10.0, rel=1e-2)  # lower bound
+
+    def test_profitable_prices_grow_design_and_dispatch_follows_price(self):
+        lmp = np.array([30.0, 60.0, 90.0, 150.0, 220.0, 300.0])
+        res = stochastic_optimization_problem(lmp, max_iter=200)
+        assert res.converged
+        assert res.p_max_mw > 50.0
+        # dispatch ordered with price: highest-LMP scenario at full output
+        assert res.op_power_mw[-1] == pytest.approx(res.p_max_mw, rel=1e-2)
+        assert res.op_power_mw[0] <= res.op_power_mw[-1] + 1e-6
+        # min-power coupling: every scenario >= 30% of design
+        assert np.all(res.op_power_mw >= 0.3 * res.p_max_mw - 1e-3)
+
+    def test_surrogate_design(self):
+        """Embed a synthetic revenue surrogate (concave in p_max) and check
+        the optimizer finds an interior design near its known optimum."""
+        import jax.numpy as jnp
+
+        # revenue peaks where marginal revenue = marginal annualized capex;
+        # rev = 3e6 * p - 6e3 * p^2  ($/yr as function of MW)
+        surro = lambda p: 3e6 * p[0] - 6e3 * p[0] ** 2
+        out = surrogate_design_problem(surro, plant_lifetime=20.0, max_iter=80)
+        assert out["converged"]
+        assert 10.0 < out["p_max_mw"] < 300.0
