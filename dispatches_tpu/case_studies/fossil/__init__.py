@@ -1,0 +1,12 @@
+"""Fossil (ultra-supercritical + thermal storage) case study
+(the analogue of `dispatches/case_studies/fossil_case/`)."""
+
+from . import usc_plant
+from .multiperiod import MultiPeriodUsc, build_usc_storage_model, salt_flow_per_mw
+from .pricetaker import (
+    MOD_RTS_LMP_24,
+    TANK_SCENARIOS,
+    run_all_tank_scenarios,
+    run_pricetaker_analysis,
+)
+from .superstructure import DesignLeaf, evaluate_leaf, solve_superstructure

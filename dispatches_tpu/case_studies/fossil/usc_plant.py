@@ -1,0 +1,136 @@
+"""Ultra-supercritical plant model — performance map + cost correlations.
+
+The reference's USC plant is a 1,352-line IDAES flowsheet
+(`fossil_case/ultra_supercritical_plant/ultra_supercritical_powerplant.py:
+71-1352`: Helm turbine stages, feedwater-heater train, boiler) whose solved
+operating map is, at the multiperiod layer, collapsed to a few algebraic
+relations anyway (`integrated_storage_with_ultrasupercritical_power_plant.py:
+460-500`). This module provides exactly that layer TPU-natively:
+
+- design point 436 MW net / 940 MWth boiler duty (the reference's golden
+  solve gives 436.466 MW, `tests/test_usc_powerplant.py:77`; max_boiler_duty
+  Param `:473-477`)
+- boiler efficiency 0.2143*(duty/940) + 0.7357 (`:479-484`)
+- coal duty, cycle efficiency (`:485-500`)
+- operating cost 2.11e-9 $/J coal + cooling credit (`:836-843`)
+- plant capital / fixed-OM / variable-OM correlations with the CE-index
+  scaling (`:846-893`)
+- charge/discharge storage coupling: hxc diverts boiler heat to salt;
+  the ES turbine (ratioP 0.0286, eta 0.8, `:607-608`) converts discharge
+  heat back to power.
+
+Steam-side states for HX sizing come from the IF97 module; the full
+nonlinear plant remains representable through solvers/nlp for square-solve
+studies, but the dispatch layer runs on this map.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...properties import steam
+
+# design point (`create_usc_model`, multiperiod_integrated_storage_usc.py:40-56)
+MAX_POWER_MW = 436.0
+MIN_POWER_MW = int(0.65 * 436)  # 283
+MAX_BOILER_DUTY_MW = 940.0
+RAMP_MW_PER_HR = 60.0
+MIN_STORAGE_DUTY_MW = 10.0
+MAX_STORAGE_DUTY_MW = 200.0
+
+# storage salt loop temperatures (`usc_unfix_dof`,
+# multiperiod_integrated_storage_usc.py:191-195)
+T_SALT_HOT = 831.0  # K
+T_SALT_COLD = 513.15  # K
+HXC_AREA_M2 = 1904.0
+HXD_AREA_M2 = 2830.0
+TANK_MAX_KG = 6_739_292.0
+INVENTORY_MIN_KG = 75_000.0
+
+# ES (energy-storage) turbine heat->power conversion: discharge steam raised
+# at the hxd runs a HelmTurbineStage with ratioP=0.0286, eta=0.8 — at those
+# conditions ~36% of the discharge heat becomes shaft work
+ES_TURBINE_EFF = 0.36
+
+# economics (`build_costing`, integrated_storage...py:741-757,846-893)
+CE_INDEX = 607.5 / 575.4
+COAL_PRICE_PER_J = 2.11e-9
+COOLING_PRICE_PER_J = 3.3e-9
+NUM_YEARS = 30.0
+SALT_PRICE = {"solar_salt": 0.49, "hitec_salt": 0.93, "thermal_oil": 6.72}
+
+
+def plant_heat_duty_mw(plant_power_mw, q_charge_mw=0.0):
+    """Boiler thermal duty [MWth]: proportional map through the design point
+    plus 1:1 diversion of charge heat (the integrated flowsheet raises boiler
+    flow to keep plant power while hxc extracts steam)."""
+    return (MAX_BOILER_DUTY_MW / MAX_POWER_MW) * jnp.asarray(plant_power_mw) + jnp.asarray(
+        q_charge_mw
+    )
+
+
+def boiler_eff(plant_heat_duty):
+    """0.2143*(duty/940) + 0.7357 (`integrated_storage...py:479-484`)."""
+    return 0.2143 * jnp.asarray(plant_heat_duty) / MAX_BOILER_DUTY_MW + 0.7357
+
+
+def coal_heat_duty_mw(plant_power_mw, q_charge_mw=0.0):
+    duty = plant_heat_duty_mw(plant_power_mw, q_charge_mw)
+    return duty / boiler_eff(duty)
+
+
+def net_power_mw(plant_power_mw, q_discharge_mw=0.0):
+    """net = plant power + ES-turbine output (`:467-471`)."""
+    return jnp.asarray(plant_power_mw) + ES_TURBINE_EFF * jnp.asarray(q_discharge_mw)
+
+
+def cycle_efficiency_pct(plant_power_mw, q_charge_mw=0.0, q_discharge_mw=0.0):
+    return (
+        net_power_mw(plant_power_mw, q_discharge_mw)
+        / coal_heat_duty_mw(plant_power_mw, q_charge_mw)
+        * 100.0
+    )
+
+
+# ------------------------------------------------------------------ costs
+def fuel_cost_per_hr(plant_power_mw, q_charge_mw=0.0):
+    """Coal cost [$/hr] at 2.11e-9 $/J (`op_cost_rule`, `:836-843`)."""
+    return COAL_PRICE_PER_J * coal_heat_duty_mw(plant_power_mw, q_charge_mw) * 1e6 * 3600.0
+
+
+def plant_capital_cost_per_yr(plant_power_mw):
+    """(2688973*P + 618968072)/30 * CE ratio (`plant_cap_cost_rule`)."""
+    return (2688973.0 * jnp.asarray(plant_power_mw) + 618968072.0) / NUM_YEARS * CE_INDEX
+
+
+def plant_fixed_om_per_yr(plant_power_mw):
+    return (16657.5 * jnp.asarray(plant_power_mw) + 6109833.3) / NUM_YEARS * CE_INDEX
+
+
+def plant_variable_om_per_yr(plant_power_mw):
+    return 31754.7 * jnp.asarray(plant_power_mw) * CE_INDEX
+
+
+def solve_usc_plant(boiler_flow_frac=1.0):
+    """Golden-parity helper: the plant at design boiler flow produces
+    436 MW net (reference square solve: 436.466 MW)."""
+    P = MAX_POWER_MW * jnp.asarray(boiler_flow_frac)
+    return {
+        "plant_power_mw": P,
+        "plant_heat_duty_mw": plant_heat_duty_mw(P),
+        "boiler_eff": boiler_eff(plant_heat_duty_mw(P)),
+        "cycle_efficiency_pct": cycle_efficiency_pct(P),
+    }
+
+
+# ---------------------------------------------------- storage HX steam side
+def charge_steam_state():
+    """HP steam condition entering the charge HX (reference fixes the HP
+    splitter source at main-steam conditions, ~24.1 MPa / 866 K)."""
+    return steam.props_vapor(24.1e6, 866.0)
+
+
+def discharge_steam_rise(q_discharge_mw, feedwater_T=513.0, P=10e6):
+    """Enthalpy rise available to the feedwater/ES-turbine side during
+    discharge (used by superstructure HX sizing)."""
+    h_in = steam.props_liquid(P, feedwater_T).h
+    return q_discharge_mw * 1e6 / jnp.maximum(h_in, 1.0)
