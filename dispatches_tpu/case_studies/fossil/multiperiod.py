@@ -42,6 +42,7 @@ def build_usc_storage_model(
     ramp_mw: float = U.RAMP_MW_PER_HR,
     periodic_inventory: bool = False,
     scale: float = 1e-3,
+    es_turbine_eff: float = U.ES_TURBINE_EFF,
 ):
     """Lower the T-hour integrated-storage dispatch LP.
 
@@ -88,7 +89,7 @@ def build_usc_storage_model(
     if periodic_inventory:
         m.add_eq(hot[T - 1 : T] - hot0)
 
-    net = p_plant + U.ES_TURBINE_EFF * q_d  # MW
+    net = p_plant + es_turbine_eff * q_d  # MW
 
     # linearized coal cost: coal duty = (duty_map)/(eff at design band).
     # boiler_eff varies 0.906..0.95 over [283,436] MW; evaluate the
@@ -100,7 +101,7 @@ def build_usc_storage_model(
 
     fixed_om_hr = float(U.plant_fixed_om_per_yr(U.MAX_POWER_MW)) / 8760.0
     var_om_mwh = float(U.plant_variable_om_per_yr(1.0)) / 8760.0
-    op_cost = fuel_cost + var_om_mwh * net + fixed_om_hr / T
+    op_cost = fuel_cost + var_om_mwh * net + fixed_om_hr
 
     revenue = lmp * net
     profit = (revenue - op_cost).sum()

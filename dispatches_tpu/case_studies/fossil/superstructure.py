@@ -135,11 +135,15 @@ def evaluate_leaf(
     T_hot = min(U.T_SALT_HOT, fluid.T_max - 5.0)
     T_cold = max(U.T_SALT_COLD, fluid.T_min + 5.0)
 
+    eta_es = U.ES_TURBINE_EFF
     if mode == "charge":
         T_steam, _p, grade = legs[steam_leg]
         # condensing steam vs counter-current fluid heating T_cold -> T_hot
         lm = _lmtd(T_steam, T_steam - 180.0, T_cold, T_hot)
     else:
+        # each discharge sink has its own ES-turbine efficiency (the
+        # reference's disjunct-specific turbine models); it must reach the
+        # dispatch LP's net-power term or all leaves score identically
         T_fw, eta_es = legs[steam_leg]
         lm = _lmtd(T_hot, T_cold, T_fw, min(T_hot - 10.0, 700.0))
 
@@ -165,6 +169,7 @@ def evaluate_leaf(
         tank_max_kg=inventory,
         max_storage_mw=q_max_mw,
         periodic_inventory=True,
+        es_turbine_eff=eta_es,
     ).build()
     params = {
         "lmp": lmp,

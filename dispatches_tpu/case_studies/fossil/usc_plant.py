@@ -27,8 +27,6 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ...properties import steam
-
 # design point (`create_usc_model`, multiperiod_integrated_storage_usc.py:40-56)
 MAX_POWER_MW = 436.0
 MIN_POWER_MW = int(0.65 * 436)  # 283
@@ -120,17 +118,3 @@ def solve_usc_plant(boiler_flow_frac=1.0):
         "boiler_eff": boiler_eff(plant_heat_duty_mw(P)),
         "cycle_efficiency_pct": cycle_efficiency_pct(P),
     }
-
-
-# ---------------------------------------------------- storage HX steam side
-def charge_steam_state():
-    """HP steam condition entering the charge HX (reference fixes the HP
-    splitter source at main-steam conditions, ~24.1 MPa / 866 K)."""
-    return steam.props_vapor(24.1e6, 866.0)
-
-
-def discharge_steam_rise(q_discharge_mw, feedwater_T=513.0, P=10e6):
-    """Enthalpy rise available to the feedwater/ES-turbine side during
-    discharge (used by superstructure HX sizing)."""
-    h_in = steam.props_liquid(P, feedwater_T).h
-    return q_discharge_mw * 1e6 / jnp.maximum(h_in, 1.0)

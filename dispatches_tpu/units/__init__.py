@@ -3,6 +3,14 @@
 from .base import Unit, connect
 from .battery import BatteryStorage
 from .pem import PEMElectrolyzer
+from .powercurve import (
+    ATB_POWERCURVE_KW,
+    ATB_RATED_KW,
+    ATB_WINDSPEEDS,
+    capacity_factor_from_pdf,
+    capacity_factor_from_speed,
+    capacity_factors,
+)
 from .splitter import ElectricalSplitter
 from .tank import SimpleHydrogenTank
 from .tank_detailed import HydrogenTankDetailed, TankState, tank_step, tank_volume

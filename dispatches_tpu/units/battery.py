@@ -29,15 +29,22 @@ class BatteryStorage(Unit):
         charging_eta: float = 0.95,
         discharging_eta: float = 0.95,
         degradation_rate: float = 1e-4,
-        duration: float = 4.0,
+        duration: Optional[float] = 4.0,  # None -> independent energy capacity
         power_capacity: Optional[float] = None,  # kW; None -> design variable
         power_capacity_ub: float = 1e8,
+        energy_capacity: Optional[float] = None,  # kWh; used when duration=None
+        energy_capacity_ub: float = 1e8,
         initial_soc: Optional[float] = 0.0,  # None -> free initial SoC var
         initial_throughput: float = 0.0,
         periodic_soc: bool = True,
         ramp_rate: Optional[float] = None,  # kWh per step bound on |Δsoc|
     ):
         super().__init__(m, name)
+        if duration is not None and energy_capacity is not None:
+            raise ValueError(
+                "energy_capacity requires duration=None (otherwise the energy "
+                "rating is coupled to power via the fixed duration)"
+            )
         self.T = T
         self.dt = dt
         self.duration = duration
@@ -93,10 +100,31 @@ class BatteryStorage(Unit):
                 - self.throughput[:-1]
                 - (dt / 2) * (self.elec_in[1:] + self.elec_out[1:])
             )
-        # capacity fade: soc <= duration*P - deg*throughput
-        m.add_le(
-            self.soc - duration * self.nameplate_power + degradation_rate * self.throughput
-        )
+        # capacity fade: soc <= E - deg*throughput, where E is either coupled
+        # to power via the fixed duration (`RE_flowsheet.py:155-156`) or an
+        # independent design variable with its own capital cost
+        # (`solar_battery_hydrogen.py:214-216`, `four_hr_battery.deactivate()`)
+        if duration is not None:
+            self.nameplate_energy = None
+            m.add_le(
+                self.soc
+                - duration * self.nameplate_power
+                + degradation_rate * self.throughput
+            )
+        else:
+            if energy_capacity is None:
+                self.nameplate_energy = self._v(
+                    "nameplate_energy", ub=energy_capacity_ub
+                )
+            else:
+                self.nameplate_energy = self._v(
+                    "nameplate_energy", lb=energy_capacity, ub=energy_capacity
+                )
+            m.add_le(
+                self.soc
+                - self.nameplate_energy
+                + degradation_rate * self.throughput
+            )
         # power bounds vs (possibly variable) nameplate
         m.add_le(self.elec_in - self.nameplate_power)
         m.add_le(self.elec_out - self.nameplate_power)

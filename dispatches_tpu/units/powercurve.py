@@ -1,0 +1,93 @@
+"""Wind turbine powercurve → capacity factors (the PySAM replacement).
+
+Parity with reference `dispatches/unit_models/wind_power.py:129-189`, which
+shells out to PySAM's Windpower module per timestep to turn a wind resource
+into a capacity factor using the ATB 2018 Market Average turbine
+(`wind_power.py:131-147`: hub 110 m, rotor 116 m, 5 MW rated, powercurve
+tabulated at 1 m/s steps). The reference uses PySAM in two degenerate modes:
+
+- ``resource_speed`` (`wind_power.py:170-183`): a Weibull with k=100, i.e. a
+  delta at the given hub-height speed — CF is just the powercurve evaluated at
+  that speed over rated power.
+- ``resource_probability_density`` (`wind_power.py:153-169`): a single
+  (speed, direction, probability=1) tuple per hour (len != 1 raises
+  NotImplementedError in the reference) — the same delta evaluation; direction
+  is irrelevant for a single wake-free turbine.
+
+Here both collapse to a differentiable `jnp.interp` over the tabulated curve,
+which vmaps over hours/scenarios and runs on device. A general PDF mode
+(probability-weighted mixture over speeds) is also provided, strictly more
+capable than the reference's single-point restriction.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+# ATB 2018 Market Average turbine powercurve (kW at integer wind speeds, m/s),
+# as configured in the reference's `setup_atb_turbine` (wind_power.py:135-141).
+ATB_POWERCURVE_KW = np.array(
+    [0, 0, 0, 40.5, 177.7, 403.9, 737.6, 1187.2, 1771.1, 2518.6,
+     3448.4, 4562.5, 5000, 5000, 5000, 5000, 5000, 5000, 5000, 5000,
+     5000, 5000, 5000, 5000, 5000, 5000, 0, 0],
+    dtype=np.float64,
+)
+ATB_WINDSPEEDS = np.arange(len(ATB_POWERCURVE_KW), dtype=np.float64)
+ATB_RATED_KW = float(ATB_POWERCURVE_KW.max())
+ATB_HUB_HEIGHT_M = 110.0
+ATB_ROTOR_DIAMETER_M = 116.0
+
+
+def capacity_factor_from_speed(speed, speeds=None, power_kw=None):
+    """CF at hub-height wind speed(s) via powercurve interpolation.
+
+    `speed` may be scalar or any array shape (hours, scenarios x hours, ...).
+    Replaces the per-timestep PySAM run of `wind_power.py:170-183`.
+    """
+    sp = jnp.asarray(ATB_WINDSPEEDS if speeds is None else speeds)
+    pw = jnp.asarray(ATB_POWERCURVE_KW if power_kw is None else power_kw)
+    rated = jnp.max(pw)
+    return jnp.interp(jnp.asarray(speed), sp, pw) / rated
+
+
+def capacity_factor_from_pdf(speed_bins, probs, speeds=None, power_kw=None):
+    """CF for a wind-speed probability mass function.
+
+    ``speed_bins``: (..., K) speeds; ``probs``: (..., K) weights summing to 1
+    along the last axis. The reference (`wind_power.py:153-169`) only supports
+    K=1; this is the general mixture.
+    """
+    probs = jnp.asarray(probs)
+    cf = capacity_factor_from_speed(speed_bins, speeds, power_kw)
+    return jnp.sum(cf * probs, axis=-1)
+
+
+def capacity_factors(resource, kind="speed"):
+    """Dispatch helper mirroring the reference's `setup_resource` branches.
+
+    ``kind='speed'``: `resource` is an array of hub-height speeds (m/s).
+    ``kind='pdf'``: `resource` is a sequence of [(speed, direction, prob), ...]
+    per hour, the reference's `resource_probability_density` layout
+    (direction is ignored — single wake-free turbine).
+    ``kind='cf'``: passthrough of direct capacity factors
+    (`wind_power.py:184-189`).
+    """
+    if kind == "speed":
+        return capacity_factor_from_speed(jnp.asarray(resource, jnp.float64))
+    if kind == "pdf":
+        rows = [np.asarray(r, np.float64).reshape(-1, 3) for r in resource]
+        k = max(r.shape[0] for r in rows)
+        sp = np.zeros((len(rows), k))
+        pr = np.zeros((len(rows), k))
+        for i, r in enumerate(rows):
+            if abs(r[:, 2].sum() - 1.0) > 1e-3:
+                raise ValueError(
+                    f"probabilities for hour {i} must sum to 1 (got {r[:, 2].sum()})"
+                )
+            sp[i, : r.shape[0]] = r[:, 0]
+            pr[i, : r.shape[0]] = r[:, 2]
+        return capacity_factor_from_pdf(sp, pr)
+    if kind == "cf":
+        return jnp.asarray(resource)
+    raise ValueError(f"unknown resource kind {kind!r}")

@@ -28,7 +28,7 @@ class SimpleHydrogenTank(Unit):
         inlet_mol,  # affine expr, mol/s (e.g. pem.h2_flow_mol)
         name: str = "h2_tank",
         dt_seconds: float = 3600.0,
-        initial_holdup: float = 0.0,
+        initial_holdup: Optional[float] = 0.0,  # None -> free initial var
         periodic_holdup: bool = True,
         capacity_mol: Optional[float] = None,  # None -> design var (mol)
     ):
@@ -38,10 +38,27 @@ class SimpleHydrogenTank(Unit):
         self.outlet_to_pipeline = self._v("outlet_to_pipeline", T)  # mol/s
         self.holdup = self._v("holdup", T)  # mol
 
+        # free initial holdup mirrors the reference's unfixed
+        # `tank_holdup_previous` under periodic linking
+        # (`solar_battery_hydrogen.py:43,60`): the optimizer picks the cyclic
+        # starting inventory
+        if initial_holdup is None:
+            if not periodic_holdup:
+                raise ValueError(
+                    "initial_holdup=None requires periodic_holdup=True: a "
+                    "free, unanchored starting inventory lets the LP conjure "
+                    "hydrogen for free"
+                )
+            self.holdup_previous = self._v("holdup_previous")
+            h0 = self.holdup_previous
+        else:
+            self.holdup_previous = None
+            h0 = float(initial_holdup)
+
         net0 = (
             inlet_mol[0:1] - self.outlet_to_turbine[0:1] - self.outlet_to_pipeline[0:1]
         )
-        m.add_eq(self.holdup[0:1] - float(initial_holdup) - dt_seconds * net0)
+        m.add_eq(self.holdup[0:1] - h0 - dt_seconds * net0)
         if T > 1:
             net = (
                 inlet_mol[1:]
@@ -60,4 +77,4 @@ class SimpleHydrogenTank(Unit):
         if periodic_holdup:
             # final holdup returns to the initial value
             # (`wind_battery_PEM_tank_turbine_LMP.py:60-66`)
-            m.add_eq(self.holdup[T - 1 : T] - float(initial_holdup))
+            m.add_eq(self.holdup[T - 1 : T] - h0)

@@ -1,0 +1,65 @@
+"""Powercurve (PySAM replacement) tests — `wind_power.py:129-189` parity."""
+import numpy as np
+import pytest
+
+from dispatches_tpu.units.powercurve import (
+    ATB_POWERCURVE_KW,
+    ATB_RATED_KW,
+    capacity_factor_from_pdf,
+    capacity_factor_from_speed,
+    capacity_factors,
+)
+
+
+def test_curve_anchor_points():
+    # below cut-in (3 m/s) no power; rated from 12 to 25; cut-out above 25
+    assert float(capacity_factor_from_speed(1.0)) == 0.0
+    assert float(capacity_factor_from_speed(12.0)) == pytest.approx(1.0)
+    assert float(capacity_factor_from_speed(20.0)) == pytest.approx(1.0)
+    assert float(capacity_factor_from_speed(27.0)) == pytest.approx(0.0)
+    # tabulated integer speeds reproduce the curve exactly
+    cf8 = float(capacity_factor_from_speed(8.0))
+    assert cf8 == pytest.approx(ATB_POWERCURVE_KW[8] / ATB_RATED_KW)
+
+
+def test_interpolation_between_points():
+    cf = float(capacity_factor_from_speed(8.5))
+    lo = ATB_POWERCURVE_KW[8] / ATB_RATED_KW
+    hi = ATB_POWERCURVE_KW[9] / ATB_RATED_KW
+    assert lo < cf < hi
+    assert cf == pytest.approx((lo + hi) / 2, rel=1e-6)
+
+
+def test_pdf_single_point_equals_speed():
+    """The reference only supports K=1 PDFs (`wind_power.py:161-163`), which
+    must reduce to the plain speed evaluation."""
+    sp = np.array([[9.0]])
+    pr = np.array([[1.0]])
+    cf_pdf = np.asarray(capacity_factor_from_pdf(sp, pr))
+    cf_sp = np.asarray(capacity_factor_from_speed(9.0))
+    np.testing.assert_allclose(cf_pdf[0], cf_sp, rtol=1e-6)
+
+
+def test_pdf_mixture():
+    sp = np.array([[6.0, 10.0]])
+    pr = np.array([[0.5, 0.5]])
+    cf = float(np.asarray(capacity_factor_from_pdf(sp, pr))[0])
+    expect = 0.5 * float(capacity_factor_from_speed(6.0)) + 0.5 * float(
+        capacity_factor_from_speed(10.0)
+    )
+    assert cf == pytest.approx(expect, rel=1e-6)
+
+
+def test_dispatch_helper_modes():
+    speeds = np.array([5.0, 10.0, 15.0])
+    np.testing.assert_allclose(
+        np.asarray(capacity_factors(speeds, kind="speed")),
+        np.asarray(capacity_factor_from_speed(speeds)),
+    )
+    pdf = [[(5.0, 180.0, 1.0)], [(10.0, 90.0, 1.0)]]
+    got = np.asarray(capacity_factors(pdf, kind="pdf"))
+    np.testing.assert_allclose(
+        got, np.asarray(capacity_factor_from_speed(np.array([5.0, 10.0]))), rtol=1e-6
+    )
+    with pytest.raises(ValueError):
+        capacity_factors([[(5.0, 0.0, 0.5)]], kind="pdf")  # probs don't sum to 1

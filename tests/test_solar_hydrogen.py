@@ -1,0 +1,105 @@
+"""PV+battery+PEM+tank+NG/H2-turbine load-following case tests.
+
+Mirrors the reference's example-day configuration
+(`solar_battery_hydrogen_inputs.py:63-77`: sin-shaped PV CF, 100 MW flat
+load/reserve, $3/MMBtu NG) and validates the device IPM solve against a CPU
+HiGHS solve of the identical LP, plus physics invariants (load balance,
+reserve feasibility, firm-capacity requirement).
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.case_studies.renewables import params as P
+from dispatches_tpu.case_studies.renewables.solar_hydrogen import (
+    SolarHydrogenDesign,
+    build_pricetaker,
+    pv_battery_hydrogen_optimize,
+    reserve_over_1hr,
+)
+from dispatches_tpu.solvers.reference import solve_lp_scipy
+
+T = 24
+PV_CFS = np.sin(np.deg2rad(np.linspace(0, 180, T)))
+LOADS_MW = np.ones(T) * 100.0
+RESERVES_MW = np.ones(T) * 100.0
+LMPS = 25.0 + 15.0 * np.sin(np.linspace(0, 2 * np.pi, T))
+NG_PRICES = np.ones(T) * 3.0
+
+
+def _params(design):
+    return {
+        "pv_cf": jnp.asarray(PV_CFS),
+        "load": jnp.asarray(LOADS_MW * 1e3),
+        "reserve_1hr": jnp.asarray(reserve_over_1hr(RESERVES_MW * 1e3)),
+        "lmp": jnp.asarray(LMPS),
+        "ng_price": jnp.asarray(NG_PRICES),
+    }
+
+
+def _run(design, **kw):
+    return pv_battery_hydrogen_optimize(
+        design.T, PV_CFS, LOADS_MW, RESERVES_MW, LMPS, NG_PRICES, design=design, **kw
+    )
+
+
+def test_vs_highs_pure_h2():
+    design = SolarHydrogenDesign(T=T)  # h2_blend_ratio=1.0
+    res = _run(design)
+    assert res["converged"]
+    prog, _ = build_pricetaker(design)
+    lp = prog.instantiate(_params(design))
+    ref = solve_lp_scipy(lp)
+    npv_ref = -ref.obj_with_offset / 1e-3
+    assert res["NPV"] == pytest.approx(npv_ref, rel=1e-4)
+
+
+def test_vs_highs_blend():
+    design = SolarHydrogenDesign(T=T, h2_blend_ratio=0.3)
+    res = _run(design)
+    assert res["converged"]
+    prog, _ = build_pricetaker(design)
+    lp = prog.instantiate(_params(design))
+    ref = solve_lp_scipy(lp)
+    assert res["NPV"] == pytest.approx(-ref.obj_with_offset / 1e-3, rel=1e-4)
+
+
+def test_load_balance_and_capacity():
+    design = SolarHydrogenDesign(T=T)
+    res = _run(design)
+    prog, sol = res["program"], res["solution"]
+    x = sol.x
+    grid = np.asarray(prog.extract("splitter.grid_elec", x))
+    batt_out = np.asarray(prog.extract("battery.elec_out", x))
+    out = grid + batt_out + res["turb_elec_kw"]
+    lhs = out + res["grid_purchase_kw"] - res["grid_sales_kw"]
+    np.testing.assert_allclose(lhs, LOADS_MW * 1e3, rtol=1e-4, atol=50.0)
+    # firm capacity: 0.33*batt + turb >= 100 MW
+    assert 0.33 * res["batt_kw"] + res["turb_kw"] >= 100e3 * (1 - 1e-4)
+
+
+def test_pure_ng_mode():
+    """h2_blend_ratio=0: turbine burns NG only, no H2 draw from the tank."""
+    design = SolarHydrogenDesign(T=T, h2_blend_ratio=0.0)
+    res = _run(design)
+    assert res["converged"]
+    prog, sol = res["program"], res["solution"]
+    to_turb = np.asarray(prog.extract("h2_tank.outlet_to_turbine", sol.x))
+    np.testing.assert_allclose(to_turb, 0.0, atol=1e-6)
+
+
+def test_reserve_binding():
+    """Total reserve components meet the requirement each hour."""
+    design = SolarHydrogenDesign(T=T)
+    res = _run(design)
+    prog, sol = res["program"], res["solution"]
+    x = sol.x
+    batt_res = np.asarray(prog.extract("battery_reserve", x))
+    turb_res = np.asarray(prog.extract("turbine_reserve", x))
+    pem_el = np.asarray(prog.extract("pem.electricity", x))
+    pv_el = np.asarray(prog.extract("pv.electricity", x))
+    pv_cap = float(np.asarray(prog.extract("pv.system_capacity", x)))
+    excess = pv_cap * PV_CFS - pv_el
+    total = batt_res + turb_res + excess + pem_el
+    req = reserve_over_1hr(RESERVES_MW * 1e3)
+    assert np.all(total >= req * (1 - 1e-3) - 100.0)
