@@ -220,6 +220,81 @@ def temperature_ph_vapor(P, h_target, T_guess=None, iters: int = 25):
     return T
 
 
+def temperature_ph_liquid(P, h_target, iters: int = 25):
+    """T with h_liquid(P, T) = h_target, fixed-iteration Newton (region 1)."""
+    P = jnp.asarray(P, jnp.result_type(float))
+    h_target = jnp.asarray(h_target, jnp.result_type(float))
+    T = jnp.broadcast_to(
+        jnp.asarray(400.0, P.dtype), jnp.broadcast_shapes(P.shape, h_target.shape)
+    )
+    for _ in range(iters):
+        pr = props_liquid(P, T)
+        T = jnp.clip(T - (pr.h - h_target) / pr.cp, 273.16, 647.0)
+    return T
+
+
+def temperature_ph_fn(P, iters: int = 25):
+    """Specialized T(h) at fixed pressure with the saturation state hoisted.
+
+    `temperature_ph` recomputes T_sat(P), h_f(P), h_g(P) on every call even
+    though they only depend on P; inner loops that invert h repeatedly at one
+    pressure (the ConcreteTES segment bisection) should build this closure
+    once instead."""
+    P = jnp.asarray(P, jnp.result_type(float))
+    Tsat = sat_temperature(P)
+    hf = props_liquid(P, Tsat).h
+    hg = props_vapor(P, Tsat).h
+
+    def t_of_h(h):
+        h = jnp.asarray(h, jnp.result_type(float))
+        T_liq = temperature_ph_liquid(P, jnp.minimum(h, hf), iters)
+        T_vap = temperature_ph_vapor(P, jnp.maximum(h, hg), iters=iters)
+        return jnp.where(h <= hf, T_liq, jnp.where(h >= hg, T_vap, Tsat))
+
+    return t_of_h
+
+
+def temperature_ph(P, h, iters: int = 25):
+    """General T(P, h) across liquid / two-phase / vapor.
+
+    Branchless composition: below h_f(P) the region-1 inverse, above h_g(P)
+    the region-2 inverse, and the exact region-4 plateau T_sat(P) in between
+    (the reference gets this from the compiled iapws95 Helmholtz package;
+    `concrete_tes.py`'s condensing charge steam and boiling discharge water
+    both live on the plateau). Near-critical pressures use the sub/super-
+    critical region-1/2 forms extrapolated to the saturation line (IF97
+    region 3 is not implemented); plateau temperatures remain exact.
+    """
+    P = jnp.asarray(P, jnp.result_type(float))
+    h = jnp.asarray(h, jnp.result_type(float))
+    Tsat = sat_temperature(P)
+    hf = props_liquid(P, Tsat).h
+    hg = props_vapor(P, Tsat).h
+    T_liq = temperature_ph_liquid(P, jnp.minimum(h, hf), iters)
+    T_vap = temperature_ph_vapor(P, jnp.maximum(h, hg), iters=iters)
+    return jnp.where(h <= hf, T_liq, jnp.where(h >= hg, T_vap, Tsat))
+
+
+def vapor_fraction_ph(P, h):
+    """Quality x in [0, 1] from (P, h); clamped outside the dome."""
+    P = jnp.asarray(P, jnp.result_type(float))
+    h = jnp.asarray(h, jnp.result_type(float))
+    Tsat = sat_temperature(P)
+    hf = props_liquid(P, Tsat).h
+    hg = props_vapor(P, Tsat).h
+    return jnp.clip((h - hf) / jnp.maximum(hg - hf, 1.0), 0.0, 1.0)
+
+
+def enthalpy_pt(P, T):
+    """h(P, T) choosing the liquid or vapor branch by T vs T_sat(P) — the
+    analogue of `iapws95.htpx(T=..., P=...)` used to pin inlet states
+    (`test_concrete_tes.py:204-207`)."""
+    P = jnp.asarray(P, jnp.result_type(float))
+    T = jnp.asarray(T, jnp.result_type(float))
+    Tsat = sat_temperature(P)
+    return jnp.where(T < Tsat, props_liquid(P, T).h, props_vapor(P, T).h)
+
+
 def temperature_ps_vapor(P, s_target, iters: int = 25):
     """T with s_vapor(P, T) = s_target (ds/dT = cp/T)."""
     P = jnp.asarray(P, jnp.result_type(float))

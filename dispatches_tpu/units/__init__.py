@@ -2,6 +2,15 @@
 
 from .base import Unit, connect
 from .battery import BatteryStorage
+from .concrete_tes import (
+    ConcreteTES,
+    FluidStream,
+    TESDesign,
+    stream_from_pt,
+    tes_period,
+    tube_side_profile,
+    u_tes,
+)
 from .pem import PEMElectrolyzer
 from .powercurve import (
     ATB_POWERCURVE_KW,

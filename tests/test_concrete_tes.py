@@ -1,0 +1,180 @@
+"""ConcreteTES golden tests against the reference's shipped expectations.
+
+Golden arrays from `dispatches/unit_models/tests/test_concrete_tes.py`
+(`_get_charge_results`, `_get_discharge_results`, `_get_combined_results`),
+produced there by IPOPT on the iapws95 Helmholtz package. Our IF97-based
+implicit solve matches wall temperatures to ~0.02 K and per-segment heat
+rates to ~0.1 W, so tolerances are set at 0.1 K / 0.5 W absolute.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dispatches_tpu.units.concrete_tes import (
+    ConcreteTES,
+    TESDesign,
+    stream_from_pt,
+    tube_side_profile,
+)
+
+D = TESDesign()
+INIT_T = np.array(
+    [750, 732.631579, 715.2631579, 697.8947368, 680.5263158, 663.1578947,
+     645.7894737, 628.4210526, 611.0526316, 593.6842105, 576.3157895,
+     558.9473684, 541.5789474, 524.2105263, 506.8421053, 489.4736842,
+     472.1052632, 454.7368421, 437.3684211, 420.0]
+)
+# inlet specs (`test_concrete_tes.py:49-54`); flows are per-tube x num_tubes
+CHARGE = stream_from_pt(0.00958 * 1000 / 18.01528 * D.num_tubes, 19.6e6, 865.0)
+DISCHARGE = stream_from_pt(3 / 18.01528 * D.num_tubes, 8.5e5, 355.0)
+
+EXP_CHARGE_WALL_P1 = np.array(
+    [768.8794598487062, 750.9141725711494, 733.1558692075599, 715.5779731910243,
+     698.1627726680688, 680.9003463323493, 663.7878525182592, 646.8291235216258,
+     630.034517306009, 613.4209816138464, 597.0123062127739, 580.8395649489671,
+     564.9418055323642, 549.3670467067806, 534.1731714688473, 519.4256478712385,
+     505.4539745384297, 491.5937379825899, 477.7335015065516, 463.87326495071187]
+)
+EXP_CHARGE_WALL_P2 = np.array(
+    [784.6536656409681, 766.7404977929137, 749.063068065682, 731.6061482700076,
+     714.3620773742523, 697.3306181729016, 680.5189998846788, 663.9421290510368,
+     647.6229432955979, 631.5928719729783, 615.8923779344503, 600.5715793487628,
+     585.6910142546371, 571.3226417304624, 557.5507863291356, 544.4703166829731,
+     532.390904452725, 521.0060428032424, 509.9453853507483, 498.88472783457166]
+)
+EXP_CHARGE_FLUID_P1 = np.array(
+    [843.4689736714969, 823.1455699108972, 803.8469084691471, 785.4414129181083,
+     767.841394508302, 750.9977353406474, 734.896366025036, 719.5562603922092,
+     705.0286981563756, 691.3975854791795, 678.7807006374081, 667.3318857141337,
+     657.2444584467377, 648.7561522064175, 642.1535350190497, 637.7607287892795,
+     637.2090239563571, 637.2090239563571, 637.2090239563571, 637.2090239563571]
+)
+EXP_CHARGE_HEAT_P1 = np.array(
+    [581.1733601639454, 562.799805895126, 550.797916698378, 544.3495732558932,
+     542.9095419858858, 546.1724178208048, 554.0507185658779, 566.6624213505045,
+     584.3263730220131, 607.5642883293052, 637.1084902874657, 673.9155426951835,
+     719.1874594203609, 774.4024252814344, 841.3422677079749, 922.0223200143666,
+     1026.585653456652, 1134.579389291451, 1242.5731245044672, 1350.5668603392658]
+)
+
+EXP_DISCHARGE_WALL_P1 = np.array(
+    [746.1063169450176, 728.4696928862526, 710.5578357626713, 692.1005335939977,
+     672.5608778723413, 650.8774474530392, 625.0196314618721, 592.1687287491123,
+     577.7317976976101, 563.8715611417704, 550.0113246657321, 536.1510881098923,
+     522.290851633854, 508.4306150780142, 494.57037860197596, 480.7101420461362,
+     464.3881408074005, 446.8174177132283, 429.1096925824503, 411.20460039012323]
+)
+EXP_DISCHARGE_FLUID_P1 = np.array(
+    [730.7230417677312, 712.0267933383869, 691.9679135183114, 669.2086286565905,
+     641.0907962507835, 602.35950271216, 542.9615404396385, 448.94200337801783]
+    + [446.0868872570418] * 8
+    + [433.8991113548745, 415.5291277145009, 396.4808700496551, 376.4554822461086]
+)
+
+EXP_COMBINED_WALL_P1 = np.array(
+    [765.6955354841449, 747.5945530427604, 729.647450335955, 711.7483058524213,
+     693.7247605780229, 675.2594659952538, 655.7351805481906, 633.9399187030289,
+     607.6602996332637, 583.7078042836023, 569.918113445112, 556.5135719077973,
+     543.4847736612935, 530.8394836200084, 518.5979406151248, 507.0088118612352,
+     495.47770245750166, 483.64954991662637, 468.15745487706835, 451.77760745990577]
+)
+EXP_COMBINED_WALL_P2 = np.array(
+    [778.777670818477, 760.5255613795055, 742.4336515266298, 724.3518312101746,
+     706.0253151971591, 686.9897863434737, 666.3750612481672, 642.5521353237004,
+     612.6541872856708, 579.6760329417091, 566.1488472205821, 555.2224540652642,
+     544.9926995318799, 535.4321187480766, 526.5379435762707, 518.6505998781274,
+     510.9949538017873, 503.1420971147642, 490.91749609805186, 474.31213027291903]
+)
+
+
+def test_geometry_and_htc():
+    """HTC surrogate (`concrete_tes.py:704-718`) at the reference geometry."""
+    assert D.htc == pytest.approx(72.333, rel=1e-3)
+    assert D.ua_segment == pytest.approx(7.7916, rel=1e-3)
+    assert D.delta_time == 1800.0
+
+
+def test_charge_mode_goldens():
+    res = ConcreteTES(D, mode="charge").hour(jnp.asarray(INIT_T), charge=CHARGE)
+    np.testing.assert_allclose(np.asarray(res.wall_temp[0]), EXP_CHARGE_WALL_P1, atol=0.1)
+    np.testing.assert_allclose(np.asarray(res.wall_temp[1]), EXP_CHARGE_WALL_P2, atol=0.1)
+    np.testing.assert_allclose(
+        np.asarray(res.charge_temp[0]), EXP_CHARGE_FLUID_P1, atol=0.1
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.heat_rate[0]), EXP_CHARGE_HEAT_P1, rtol=2e-3, atol=0.5
+    )
+    # charge outlet is condensing at T_sat(19.6 MPa)
+    from dispatches_tpu.properties.steam import sat_temperature
+
+    t_out = float(res.charge_temp[-1, -1])
+    assert t_out == pytest.approx(float(sat_temperature(19.6e6)), abs=0.01)
+
+
+def test_discharge_mode_goldens():
+    res = ConcreteTES(D, mode="discharge").hour(
+        jnp.asarray(INIT_T), discharge=DISCHARGE
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.wall_temp[0]), EXP_DISCHARGE_WALL_P1, atol=0.1
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.discharge_temp[0]), EXP_DISCHARGE_FLUID_P1, atol=0.1
+    )
+    # all heat rates negative: concrete is being drained
+    assert np.all(np.asarray(res.heat_rate) < 0)
+
+
+def test_combined_mode_goldens():
+    res = ConcreteTES(D, mode="combined").hour(
+        jnp.asarray(INIT_T), charge=CHARGE, discharge=DISCHARGE
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.wall_temp[0]), EXP_COMBINED_WALL_P1, atol=0.1
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.wall_temp[1]), EXP_COMBINED_WALL_P2, atol=0.1
+    )
+    # discharge water boils: outlet (segment 1) is superheated above T_sat
+    assert float(res.discharge_temp[0, 0]) == pytest.approx(750.64, abs=0.5)
+
+
+def test_combined_small_discharge():
+    """The reference's second combined fixture (`test_concrete_tes.py:277`):
+    near-zero discharge flow must not break the implicit solve."""
+    small = stream_from_pt((0.01 / 3) * 3 / 18.01528 * D.num_tubes, 8.5e5, 355.0)
+    res = ConcreteTES(D, mode="combined").hour(
+        jnp.asarray(INIT_T), charge=CHARGE, discharge=small
+    )
+    w = np.asarray(res.wall_temp)
+    assert np.all(np.isfinite(w))
+    # walls must track the charge-only solution within a few K
+    np.testing.assert_allclose(w[0], EXP_CHARGE_WALL_P1, atol=5.0)
+
+
+def test_tube_side_profile_standalone():
+    """ConcreteTubeSide as its own unit (`heat_exchanger_tube.py` parity):
+    fluid pass against a fixed wall profile conserves energy."""
+    prof = tube_side_profile(D, jnp.asarray(INIT_T), CHARGE, "charge")
+    mdot = float(CHARGE.flow_mol) / D.num_tubes * 18.01528e-3
+    h_in = float(CHARGE.enth_mol) / 18.01528e-3
+    h_out = float(prof.enth_mol[-1]) / 18.01528e-3
+    q_total = float(jnp.sum(prof.heat_duty))
+    assert q_total == pytest.approx(mdot * (h_out - h_in), rel=1e-10)
+    # monotone cooling along the tube
+    t = np.asarray(prof.temperature)
+    assert np.all(np.diff(t) <= 1e-9)
+
+
+def test_hour_is_jittable_and_differentiable():
+    tes = ConcreteTES(D, mode="charge")
+
+    def stored_energy(flow_mol):
+        ch = stream_from_pt(flow_mol, 19.6e6, 865.0)
+        res = tes.hour(jnp.asarray(INIT_T), charge=ch)
+        return jnp.sum(res.wall_temp[-1] - jnp.asarray(INIT_T))
+
+    g = jax.jit(jax.grad(stored_energy))(jnp.asarray(5317.0))
+    assert np.isfinite(float(g))
+    assert float(g) > 0  # more steam flow -> more heat stored

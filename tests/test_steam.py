@@ -103,3 +103,35 @@ class TestInversionsAndCycle:
 
         g = jax.grad(lambda T: steam.turbine_expansion(12e6, T, 0.01e6, 0.87).work)(700.0)
         assert float(g) > 0.0  # hotter inlet -> more work
+
+
+class TestGeneralPHInverse:
+    """temperature_ph across liquid / two-phase / vapor (ConcreteTES path)."""
+
+    def test_liquid_branch(self):
+        P, T = 8.5e5, 355.0
+        h = steam.props_liquid(P, T).h
+        assert float(steam.temperature_ph(P, h)) == pytest.approx(T, abs=1e-3)
+
+    def test_vapor_branch(self):
+        P, T = 19.6e6, 865.0
+        h = steam.props_vapor(P, T).h
+        assert float(steam.temperature_ph(P, h)) == pytest.approx(T, abs=1e-2)
+
+    def test_two_phase_plateau(self):
+        P = 8.5e5
+        hf = steam.sat_liquid(P).h
+        hg = steam.sat_vapor(P).h
+        Tsat = float(steam.sat_temperature(P))
+        for frac in (0.1, 0.5, 0.9):
+            h = float(hf + frac * (hg - hf))
+            assert float(steam.temperature_ph(P, h)) == pytest.approx(Tsat, abs=1e-9)
+            assert float(steam.vapor_fraction_ph(P, h)) == pytest.approx(frac, abs=1e-9)
+
+    def test_enthalpy_pt_branch_selection(self):
+        P = 8.5e5
+        Tsat = float(steam.sat_temperature(P))
+        h_liq = float(steam.enthalpy_pt(P, Tsat - 30))
+        h_vap = float(steam.enthalpy_pt(P, Tsat + 30))
+        assert h_liq < float(steam.sat_liquid(P).h)
+        assert h_vap > float(steam.sat_vapor(P).h)
