@@ -13,3 +13,7 @@ from .stochastic import (
     stochastic_optimization_problem,
     surrogate_design_problem,
 )
+from .surrogate_design import (
+    MarketInputBounds,
+    conceptual_design_problem_nn,
+)

@@ -1,0 +1,20 @@
+"""Renewables case study — the analogue of
+`dispatches/case_studies/renewables_case/`."""
+
+from .conceptual_design import (
+    ConceptualDesignInputs,
+    conceptual_design_dynamic_RE,
+    design_sweep,
+)
+from .pricetaker import (
+    HybridDesign,
+    build_pricetaker,
+    wind_battery_optimize,
+    wind_battery_pem_optimize,
+    wind_battery_pem_tank_turb_optimize,
+)
+from .solar_hydrogen import (
+    SolarHydrogenDesign,
+    pv_battery_hydrogen_optimize,
+    reserve_over_1hr,
+)

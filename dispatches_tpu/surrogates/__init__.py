@@ -1,0 +1,12 @@
+"""Market-surrogate stack — the analogue of
+`dispatches/workflow/train_market_surrogates/dynamic/` + `util/surrogates.py`."""
+
+from .clustering import KMeansResult, TimeSeriesClustering, kmeans
+from .data import SimulationData
+from .embed import (
+    AlamoSurrogate,
+    smooth_nonneg,
+    surrogate_fn,
+    train_surrogate_model,
+)
+from .train import SurrogateMLP, TrainedSurrogate, TrainNNSurrogates, train_surrogate

@@ -1,0 +1,180 @@
+"""Surrogate embedding + the uniform training front-end (OMLT/ALAMO analogue).
+
+The reference encodes trained networks into Pyomo constraints via OMLT
+(`RE_surrogate_optimization_steadystate.py:130-166`,
+`surrogate_design_scikit.py:140-176`) and trains symbolic-regression models
+with the commercial ALAMO binary (`util/surrogates.py:30-69`). Under
+autodiff neither encoding exists: a surrogate is just a differentiable
+function called inside the design objective. This module provides
+
+- :func:`smooth_nonneg` — the reference's smooth-max trick
+  ``0.5*sqrt(y^2 + eps^2) + 0.5*y`` used on every surrogate output that must
+  stay nonnegative (`surrogate_design_scikit.py:152,167,231`);
+- :class:`AlamoSurrogate` — polynomial/interaction basis fit by linear least
+  squares, the TPU-native replacement for the ALAMO symbolic-regression
+  binary (same save/load JSON idea as `alm_surr.save_to_file`);
+- :func:`train_surrogate_model` — the uniform front-end over
+  alamo/keras/scikit trainers (`util/surrogates.py:123-228`); the two NN
+  backends both map to the Flax trainer (`train.py`), "alamo" to the basis
+  regression.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from .train import TrainedSurrogate, train_surrogate
+
+
+def smooth_nonneg(y, eps: float = 1e-3):
+    """Smooth max(y, 0): 0.5*sqrt(y^2+eps^2) + 0.5*y."""
+    return 0.5 * jnp.sqrt(y**2 + eps**2) + 0.5 * y
+
+
+def surrogate_fn(sur) -> Callable:
+    """Wrap a TrainedSurrogate (or any .predict object) as a plain function
+    on a single input vector — the "formulation" step of OMLT, reduced to a
+    closure. Output shape (out_dim,)."""
+
+    def f(x):
+        x = jnp.asarray(x)
+        return jnp.reshape(sur.predict(x[None, :]), (-1,))
+
+    return f
+
+
+class AlamoSurrogate:
+    """Least-squares regression on a fixed monomial/interaction basis.
+
+    The feature set mirrors ALAMO's default basis options (constant, linear,
+    integer powers, pairwise products); the fit is a single batched
+    ``lstsq`` on device instead of the MILP-driven external binary.
+    """
+
+    def __init__(
+        self,
+        coef: np.ndarray,
+        powers: Sequence[int] = (1, 2, 3),
+        interactions: bool = True,
+        x_labels: Optional[Sequence[str]] = None,
+        z_labels: Optional[Sequence[str]] = None,
+    ):
+        self.coef = jnp.asarray(coef)  # (F, out)
+        self.powers = tuple(powers)
+        self.interactions = bool(interactions)
+        self.x_labels = list(x_labels) if x_labels else None
+        self.z_labels = list(z_labels) if z_labels else None
+
+    # -- basis ----------------------------------------------------------
+    @staticmethod
+    def features(X, powers=(1, 2, 3), interactions=True):
+        X = jnp.asarray(X)
+        cols = [jnp.ones(X.shape[:-1] + (1,), X.dtype)]
+        for p in powers:
+            cols.append(X**p)
+        if interactions and X.shape[-1] > 1:
+            n = X.shape[-1]
+            iu, ju = np.triu_indices(n, k=1)
+            cols.append(X[..., iu] * X[..., ju])
+        return jnp.concatenate(cols, axis=-1)
+
+    # -- fit / predict --------------------------------------------------
+    @classmethod
+    def fit(
+        cls,
+        X,
+        z,
+        powers: Sequence[int] = (1, 2, 3),
+        interactions: bool = True,
+        ridge: float = 1e-10,
+        x_labels=None,
+        z_labels=None,
+    ) -> "AlamoSurrogate":
+        X = jnp.asarray(X, jnp.result_type(float))
+        z = jnp.asarray(z, jnp.result_type(float))
+        if z.ndim == 1:
+            z = z[:, None]
+        F = cls.features(X, powers, interactions)
+        # ridge-regularized normal equations keep the solve vmappable
+        A = F.T @ F + ridge * jnp.eye(F.shape[1], dtype=F.dtype)
+        coef = jnp.linalg.solve(A, F.T @ z)
+        return cls(coef, powers, interactions, x_labels, z_labels)
+
+    def predict(self, X):
+        F = self.features(jnp.asarray(X), self.powers, self.interactions)
+        return F @ self.coef
+
+    def r2(self, X, z):
+        z = np.asarray(z)
+        if z.ndim == 1:
+            z = z[:, None]
+        pred = np.asarray(self.predict(X))
+        ss_res = ((z - pred) ** 2).sum(0)
+        ss_tot = ((z - z.mean(0)) ** 2).sum(0)
+        return 1.0 - ss_res / np.maximum(ss_tot, 1e-30)
+
+    # -- persistence (the `alm_surr.save_to_file` analogue) -------------
+    def save(self, path: str):
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "coef": np.asarray(self.coef).tolist(),
+                    "powers": list(self.powers),
+                    "interactions": self.interactions,
+                    "x_labels": self.x_labels,
+                    "z_labels": self.z_labels,
+                },
+                f,
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "AlamoSurrogate":
+        with open(path) as f:
+            d = json.load(f)
+        return cls(
+            np.asarray(d["coef"]),
+            tuple(d["powers"]),
+            d["interactions"],
+            d["x_labels"],
+            d["z_labels"],
+        )
+
+
+def train_surrogate_model(
+    x_data,
+    z_data,
+    method: str = "keras",
+    x_labels: Optional[Sequence[str]] = None,
+    z_labels: Optional[Sequence[str]] = None,
+    hidden_layers: Sequence[int] = (100, 50),
+    epochs: int = 500,
+    config: Optional[Dict] = None,
+):
+    """Uniform training front-end (`util/surrogates.py:123-228` parity).
+
+    method='alamo'  -> :class:`AlamoSurrogate` basis regression
+    method='keras' | 'scikit' -> Flax MLP via :func:`train_surrogate`
+    Returns (surrogate, metrics dict with per-output R2).
+    """
+    x = np.asarray(x_data, float)
+    z = np.asarray(z_data, float)
+    if method == "alamo":
+        cfg = config or {}
+        sur = AlamoSurrogate.fit(
+            x,
+            z,
+            powers=tuple(cfg.get("powers", (1, 2, 3))),
+            interactions=bool(cfg.get("interactions", True)),
+            x_labels=x_labels,
+            z_labels=z_labels,
+        )
+        return sur, {"R2": sur.r2(x, z)}
+    if method in ("keras", "scikit"):
+        sur, metrics = train_surrogate(
+            x, z, hidden=tuple(hidden_layers), epochs=epochs
+        )
+        return sur, metrics
+    raise ValueError(f"unknown surrogate method {method!r}")

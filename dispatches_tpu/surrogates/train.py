@@ -126,8 +126,8 @@ def train_surrogate(
         "xstd_inputs": xs.tolist(),
         "xmin": ((X.min(0) - xm) / xs).tolist(),
         "xmax": ((X.max(0) - xm) / xs).tolist(),
-        "y_mean": ym.tolist() if ym.size > 1 else float(ym),
-        "y_std": ys.tolist() if ys.size > 1 else float(ys),
+        "y_mean": ym.tolist() if ym.size > 1 else float(ym.item()),
+        "y_std": ys.tolist() if ys.size > 1 else float(ys.item()),
     }
     sur = TrainedSurrogate(model, params, scaling)
     pred = np.asarray(sur.predict(X))

@@ -1,0 +1,182 @@
+"""Surrogate-embedding + conceptual-design tests (the OMLT/ALAMO path)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.surrogates.embed import (
+    AlamoSurrogate,
+    smooth_nonneg,
+    surrogate_fn,
+    train_surrogate_model,
+)
+from dispatches_tpu.case_studies.renewables.conceptual_design import (
+    ConceptualDesignInputs,
+    conceptual_design_dynamic_RE,
+    design_sweep,
+)
+from dispatches_tpu.case_studies.rankine.surrogate_design import (
+    conceptual_design_problem_nn,
+)
+
+
+class TestEmbed:
+    def test_smooth_nonneg(self):
+        assert float(smooth_nonneg(5.0)) == pytest.approx(5.0, abs=1e-3)
+        assert float(smooth_nonneg(-5.0)) == pytest.approx(0.0, abs=1e-3)
+        assert float(smooth_nonneg(0.0)) == pytest.approx(5e-4, abs=1e-6)
+
+    def test_alamo_exact_polynomial(self):
+        rng = np.random.default_rng(0)
+        X = rng.uniform(-2, 2, (200, 2))
+        z = 3.0 + 2.0 * X[:, 0] - X[:, 1] ** 2 + 0.5 * X[:, 0] * X[:, 1]
+        sur = AlamoSurrogate.fit(X, z, powers=(1, 2), interactions=True)
+        r2 = sur.r2(X, z)
+        assert r2[0] > 1 - 1e-8  # basis contains the truth -> exact fit
+        pred = float(np.asarray(sur.predict(np.array([[1.0, 1.0]])))[0, 0])
+        assert pred == pytest.approx(3.0 + 2.0 - 1.0 + 0.5, abs=1e-6)
+
+    def test_alamo_save_load_roundtrip(self, tmp_path):
+        X = np.random.default_rng(1).uniform(0, 1, (50, 3))
+        z = X.sum(1)
+        sur = AlamoSurrogate.fit(X, z, x_labels=["a", "b", "c"], z_labels=["s"])
+        p = tmp_path / "alamo.json"
+        sur.save(str(p))
+        sur2 = AlamoSurrogate.load(str(p))
+        np.testing.assert_allclose(
+            np.asarray(sur.predict(X)), np.asarray(sur2.predict(X)), rtol=1e-12
+        )
+        assert sur2.x_labels == ["a", "b", "c"]
+
+    def test_front_end_methods(self):
+        rng = np.random.default_rng(2)
+        X = rng.uniform(0, 1, (100, 2))
+        z = X[:, 0] + 2 * X[:, 1]
+        sur_a, m_a = train_surrogate_model(X, z, method="alamo")
+        assert m_a["R2"][0] > 0.999
+        sur_k, m_k = train_surrogate_model(
+            X, z, method="keras", hidden_layers=(16,), epochs=800
+        )
+        assert float(np.asarray(m_k["R2"])[0]) > 0.9
+        with pytest.raises(ValueError):
+            train_surrogate_model(X, z, method="gp")
+
+
+def _analytic_surrogates(K=4):
+    """Closed-form 'surrogates': revenue grows with PEM size up to a soft
+    cap and with bid; frequencies favour mid clusters."""
+
+    def rev_fn(inp):
+        bid, size_scaled = inp[0], inp[1]
+        return jnp.reshape(4e7 + 1e5 * size_scaled - 2e4 * (bid - 30.0) ** 2, (1,))
+
+    def freq_fn(inp):
+        base = jnp.arange(1.0, K + 1.0)
+        return base / (1.0 + 0.01 * inp[0]) - 0.1
+
+    return rev_fn, freq_fn
+
+
+class TestREConceptualDesign:
+    D = ConceptualDesignInputs(
+        dispatch_cf=np.array([0.1, 0.3, 0.5, 0.2]),
+        pem_cf=np.array([0.3, 0.4, 0.2, 0.5]),
+        wind_cf=np.array([0.5, 0.8, 0.75, 0.9]),
+    )
+
+    def test_design_solution(self):
+        rev_fn, freq_fn = _analytic_surrogates()
+        res = conceptual_design_dynamic_RE(self.D, rev_fn, freq_fn)
+        assert res["converged"]
+        assert res["wind_mw"] == pytest.approx(847.0, rel=1e-6)  # extant fix
+        assert 127.5 <= res["pem_mw"] <= 423.5
+        freqs = [res[f"freq_day_{k}"] for k in range(4)]
+        assert sum(freqs) == pytest.approx(1.0, abs=1e-6)
+        # at $2/kg H2 and these CFs the PEM NPV term is positive -> sized up
+        assert res["pem_mw"] == pytest.approx(423.5, rel=1e-3)
+
+    def test_fixed_bid_and_size(self):
+        rev_fn, freq_fn = _analytic_surrogates()
+        res = conceptual_design_dynamic_RE(
+            self.D, rev_fn, freq_fn, PEM_bid=25.0, PEM_MW=200.0
+        )
+        assert res["pem_bid"] == pytest.approx(25.0, abs=1e-4)
+        assert res["pem_mw"] == pytest.approx(200.0, rel=1e-4)
+
+    def test_sweep_matches_pointwise(self):
+        rev_fn, freq_fn = _analytic_surrogates()
+        sweep = design_sweep(
+            self.D, rev_fn, freq_fn, pem_bids=np.array([20.0, 30.0]),
+            pem_mws=np.array([150.0, 300.0]),
+        )
+        assert sweep["NPV"].shape == (4,)
+        assert np.all(np.isfinite(sweep["NPV"]))
+        # revenue peaks at bid=30 in the analytic model -> higher NPV there
+        npv_b20 = sweep["NPV"][sweep["pem_bid"] == 20.0]
+        npv_b30 = sweep["NPV"][sweep["pem_bid"] == 30.0]
+        assert np.all(npv_b30 > npv_b20)
+        # sweep agrees with the pointwise optimizer at the same fixed point
+        res = conceptual_design_dynamic_RE(
+            self.D, rev_fn, freq_fn, PEM_bid=30.0, PEM_MW=300.0
+        )
+        k = np.where((sweep["pem_bid"] == 30.0) & (sweep["pem_mw"] == 300.0))[0][0]
+        assert sweep["NPV"][k] == pytest.approx(res["NPV"], rel=1e-5)
+
+    def test_with_trained_flax_surrogate(self):
+        """End-to-end: train tiny Flax nets on synthetic sweep data and run
+        the design problem through them (the full reference pipeline)."""
+        rng = np.random.default_rng(3)
+        X = np.column_stack(
+            [
+                rng.uniform(15, 45, 400),
+                rng.uniform(100, 500, 400),
+                np.full(400, 15.0),
+                np.full(400, 1000.0),
+            ]
+        )
+        rev = 4e7 + 1e5 * X[:, 1] - 2e4 * (X[:, 0] - 30) ** 2
+        fr = np.column_stack([np.full(400, c) for c in (0.1, 0.2, 0.3, 0.4)])
+        sur_rev, m1 = train_surrogate_model(
+            X, rev, method="keras", hidden_layers=(32,), epochs=300
+        )
+        sur_fr, _ = train_surrogate_model(
+            X, fr, method="keras", hidden_layers=(16,), epochs=200
+        )
+        res = conceptual_design_dynamic_RE(
+            self.D, surrogate_fn(sur_rev), surrogate_fn(sur_fr)
+        )
+        assert res["converged"]
+        assert np.isfinite(res["NPV"])
+
+
+class TestRankineNNDesign:
+    @staticmethod
+    def _surrogates():
+        def rev_fn(inp):  # MM$/yr, favors big plants and mid marginal cost
+            pmax, marg = inp[0], inp[5]
+            return jnp.reshape(0.5 * pmax - 0.05 * (marg - 18.0) ** 2, (1,))
+
+        def nstartups_fn(inp):
+            return jnp.reshape(50.0 - 2.0 * inp[3], (1,))  # fewer w/ min_up
+
+        def zone_fn(inp):
+            z = jnp.linspace(2.0, 1.0, 11)
+            return z * (1.0 + 0.001 * inp[0])
+
+        return rev_fn, nstartups_fn, zone_fn
+
+    def test_design_solves(self):
+        rev_fn, ns_fn, z_fn = self._surrogates()
+        res = conceptual_design_problem_nn(rev_fn, ns_fn, z_fn)
+        assert res["converged"]
+        assert 10.0 <= res["pmax_mw"] <= 300.0
+        assert res["zone_hours"].sum() == pytest.approx(8736.0, rel=1e-6)
+        assert res["pmin_mw"] == pytest.approx(
+            res["pmin_multi"] * res["pmax_mw"], rel=1e-6
+        )
+
+    def test_fix_market_var(self):
+        rev_fn, ns_fn, z_fn = self._surrogates()
+        res = conceptual_design_problem_nn(
+            rev_fn, ns_fn, z_fn, fix={"marg_cst": 12.0}
+        )
+        assert res["marg_cst"] == pytest.approx(12.0, abs=1e-5)
