@@ -21,9 +21,13 @@ import jax.numpy as jnp
 
 from ...solvers.nlp import solve_nlp
 from ...surrogates.embed import smooth_nonneg
-from .flowsheet import RankineSpec, capital_cost_musd, solve_rankine, specific_energies
-
-MW_WATER = 0.01801528
+from .flowsheet import (
+    MW_WATER,
+    RankineSpec,
+    capital_cost_musd,
+    solve_rankine,
+    specific_energies,
+)
 
 # zone grid: fraction of (pmax - pmin) above pmin; zone 0 handled as "off"
 # (`surrogate_design_scikit.py:93`)

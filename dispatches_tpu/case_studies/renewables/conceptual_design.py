@@ -176,6 +176,12 @@ def design_sweep(
 
     def solve_point(bid_size):
         bid, size_mw = bid_size[0], bid_size[1]
+        if lw == uw:
+            # extant wind: nothing left to optimize — evaluate directly
+            return _npv_terms(
+                jnp.asarray(lw, bid.dtype), size_mw * 1e3, bid, d,
+                revenue_fn, frequency_fn,
+            )
         x0 = jnp.asarray([0.5 * (lw + uw)], bid_size.dtype)
         sol = solve_nlp(
             lambda x, p: -_npv_terms(

@@ -333,6 +333,19 @@ class CompiledLP:
     def _full(self, x: jnp.ndarray) -> jnp.ndarray:
         return self.expand(x) if x.shape[-1] == self.N else x
 
+    def col_index(self, name: str) -> np.ndarray:
+        """Reduced-column indices of a named variable in the solution vector
+        (for solvers that add terms on specific coordinates, e.g. the
+        chunk-boundary penalties of `parallel/time_axis.py`)."""
+        vm = self._vars[name]
+        full = np.arange(vm.start, vm.start + vm.size)
+        red = np.searchsorted(self._keep_cols, full)
+        if red.max(initial=-1) >= len(self._keep_cols) or np.any(
+            self._keep_cols[red] != full
+        ):
+            raise ValueError(f"variable {name!r} has fixed (presolved) columns")
+        return red
+
     def extract(self, name: str, x: jnp.ndarray) -> jnp.ndarray:
         """Pull a named variable's values out of a solution vector (batched ok)."""
         x = self._full(x)

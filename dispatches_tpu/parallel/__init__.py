@@ -1,0 +1,12 @@
+"""Parallelism layer: scenario sharding (DP analogue) + time-axis horizon
+decomposition (SP/CP analogue) over `jax.sharding.Mesh` (SURVEY.md §2.7)."""
+
+from .mesh import pad_batch, scenario_mesh, solve_lp_sharded
+from .time_axis import (
+    HorizonSolution,
+    WindBatteryChunk,
+    build_chunk,
+    coarse_boundary_states,
+    solve_horizon_admm,
+    wind_battery_horizon_solve,
+)

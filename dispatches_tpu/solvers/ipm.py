@@ -30,7 +30,7 @@ class IPMSolution(NamedTuple):
     y: jnp.ndarray  # equality duals
     zl: jnp.ndarray  # lower-bound duals (0 where bound infinite)
     zu: jnp.ndarray  # upper-bound duals
-    obj: jnp.ndarray  # c.x + c0
+    obj: jnp.ndarray  # c.x + c0 (+ 1/2 x.diag(q).x when q given)
     converged: jnp.ndarray  # bool
     iterations: jnp.ndarray
     res_primal: jnp.ndarray
@@ -75,8 +75,15 @@ def solve_lp(
     reg_p: float = None,
     reg_d: float = None,
     refine_steps: int = 2,
+    q: jnp.ndarray = None,
 ) -> IPMSolution:
     """Scale (Ruiz + norm), solve, unscale. See `_solve_scaled` for the core.
+
+    `q` (optional, (N,) >= 0) adds a diagonal quadratic term
+    ``+ 1/2 x.diag(q).x`` to the objective — the subproblem shape of the
+    horizon-consensus ADMM (`parallel/time_axis.py`), solved exactly by the
+    same Mehrotra iteration (diagonal Q keeps the normal equations' inner
+    matrix diagonal).
 
     Default regularizations are dtype-aware: large enough to keep the normal
     equations factorizable, small enough not to bias mid-box variables (a
@@ -103,6 +110,8 @@ def solve_lp(
             jnp.max(jnp.where(jnp.isfinite(l), jnp.abs(l), 0.0)),
         ),
     )
+    q0 = jnp.zeros_like(c0v) if q is None else jnp.asarray(q, c0v.dtype)
+    q_s = q0 * cs * cs * sig_b / sig_c
     sol = _solve_scaled(
         LPData(A, b / sig_b, c / sig_c, l / sig_b, u / sig_b, jnp.zeros_like(off0)),
         tol,
@@ -110,13 +119,14 @@ def solve_lp(
         reg_p,
         reg_d,
         refine_steps,
+        q_s,
     )
     # unscale: x = cs * x~ * sig_b ; y = sig_c * r * y~ ; z = sig_c/cs * z~
     x = sol.x * cs * sig_b
     y = sol.y * r * sig_c
     zl = sol.zl / cs * sig_c
     zu = sol.zu / cs * sig_c
-    obj = c0v @ x + off0
+    obj = c0v @ x + 0.5 * (q0 * x) @ x + off0
     return IPMSolution(
         x=x,
         y=y,
@@ -138,9 +148,11 @@ def _solve_scaled(
     reg_p: float = 1e-9,
     reg_d: float = 1e-9,
     refine_steps: int = 1,
+    q: jnp.ndarray = None,
 ) -> IPMSolution:
     A, b, c, l, u, c0 = lp
     dtype = A.dtype
+    q = jnp.zeros_like(c) if q is None else q
     M, N = A.shape
     fl = jnp.isfinite(l)
     fu = jnp.isfinite(u)
@@ -166,7 +178,7 @@ def _solve_scaled(
 
     def residuals(x, y, zl, zu):
         rp = b - A @ x
-        rd = c - A.T @ y - zl + zu
+        rd = c + q * x - A.T @ y - zl + zu
         xl = jnp.where(fl, x - l_s, 1.0)
         xu = jnp.where(fu, u_s - x, 1.0)
         comp = jnp.sum(jnp.where(fl, xl * zl, 0.0)) + jnp.sum(
@@ -185,7 +197,7 @@ def _solve_scaled(
         zl_s = jnp.where(fl, zl, 0.0)
         zu_s = jnp.where(fu, zu, 0.0)
         rp = b - A @ x
-        rd = c - A.T @ y - zl_s + zu_s
+        rd = c + q * x - A.T @ y - zl_s + zu_s
         mu = (
             jnp.sum(jnp.where(fl, xl * zl, 0.0))
             + jnp.sum(jnp.where(fu, xu * zu, 0.0))
@@ -194,6 +206,7 @@ def _solve_scaled(
         d = (
             jnp.where(fl, zl / xl, 0.0)
             + jnp.where(fu, zu / xu, 0.0)
+            + q
             + jnp.asarray(reg_p, dtype)
         )
         w = 1.0 / d

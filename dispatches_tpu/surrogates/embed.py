@@ -173,8 +173,18 @@ def train_surrogate_model(
         )
         return sur, {"R2": sur.r2(x, z)}
     if method in ("keras", "scikit"):
+        cfg = config or {}
         sur, metrics = train_surrogate(
-            x, z, hidden=tuple(hidden_layers), epochs=epochs
+            x,
+            z,
+            hidden=tuple(hidden_layers),
+            epochs=epochs,
+            lr=float(cfg.get("learning_rate", 1e-3)),
+            seed=int(cfg.get("seed", 0)),
         )
+        if x_labels is not None:
+            sur.scaling["x_labels"] = list(x_labels)
+        if z_labels is not None:
+            sur.scaling["z_labels"] = list(z_labels)
         return sur, metrics
     raise ValueError(f"unknown surrogate method {method!r}")

@@ -35,7 +35,7 @@ class BatteryStorage(Unit):
         energy_capacity: Optional[float] = None,  # kWh; used when duration=None
         energy_capacity_ub: float = 1e8,
         initial_soc: Optional[float] = 0.0,  # None -> free initial SoC var
-        initial_throughput: float = 0.0,
+        initial_throughput: Optional[float] = 0.0,  # None -> free initial var
         periodic_soc: bool = True,
         ramp_rate: Optional[float] = None,  # kWh per step bound on |Δsoc|
     ):
@@ -88,10 +88,17 @@ class BatteryStorage(Unit):
                 - ec * dt * self.elec_in[1:]
                 + (dt / ed) * self.elec_out[1:]
             )
-        # throughput accumulation
+        # throughput accumulation; free initial throughput supports horizon
+        # decomposition (chunk-boundary consensus, parallel/time_axis.py)
+        if initial_throughput is None:
+            self.initial_throughput = self._v("initial_throughput")
+            tp0 = self.initial_throughput
+        else:
+            self.initial_throughput = None
+            tp0 = float(initial_throughput)
         m.add_eq(
             self.throughput[0:1]
-            - float(initial_throughput)
+            - tp0
             - (dt / 2) * (self.elec_in[0:1] + self.elec_out[0:1])
         )
         if T > 1:
