@@ -1,0 +1,370 @@
+// Native runtime kernels for dispatches_tpu.
+//
+// The reference delegates its heavy host-side work to external native code
+// (AMPL .nl writer/ASL, solver binaries, TensorFlow; SURVEY.md §2.6). The
+// TPU-native framework keeps compute on-device, but the host runtime around
+// it — bulk IO of Prescient sweep outputs (`Simulation_Data.py:138-221`
+// reads 10k-run x 8736-h dispatch CSVs), sparse model assembly, and
+// sweep-result checkpointing (`run_pricetaker_wind_PEM.py:43-50`) — is
+// native here, exposed through a plain C ABI for ctypes
+// (dispatches_tpu/runtime/native.py).
+//
+// Build: see dispatches_tpu/runtime/native.py (auto-compiles with g++) or
+// csrc/Makefile.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <vector>
+#include <thread>
+#include <atomic>
+#include <mutex>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- CSV IO
+//
+// Two-phase, memory-mapped numeric CSV reader. Phase 1 (csv_open) maps the
+// file, counts rows/columns and records row offsets; phase 2 (csv_read)
+// parses in parallel into a caller-allocated row-major double buffer.
+// Non-numeric header rows are skipped; empty cells and non-numeric cells
+// parse as NaN. Returns a handle id, or -1 on failure.
+
+struct CsvFile {
+  char* data = nullptr;
+  size_t size = 0;
+  std::vector<size_t> row_offsets;  // offset of each data row
+  int64_t ncols = 0;
+  int64_t nrows = 0;
+  int64_t skipped_header = 0;
+};
+
+static std::vector<CsvFile*> g_csvs;
+static std::mutex g_csvs_mu;  // ctypes releases the GIL during calls
+
+static CsvFile* csv_get(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_csvs_mu);
+  if (h < 0 || h >= (int64_t)g_csvs.size()) return nullptr;
+  return g_csvs[h];
+}
+
+static bool row_is_numeric(const char* p, const char* end) {
+  // a row is "numeric" if its first non-space cell starts with a digit,
+  // sign, dot, 'n'/'N' (nan), 'i'/'I' (inf), or is empty (leading comma)
+  while (p < end && (*p == ' ' || *p == '\t')) p++;
+  if (p >= end) return false;
+  char c = *p;
+  return (c >= '0' && c <= '9') || c == '-' || c == '+' || c == '.' ||
+         c == 'n' || c == 'N' || c == 'i' || c == 'I' || c == ',';
+}
+
+int64_t csv_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -1; }
+  auto* f = new CsvFile();
+  f->size = (size_t)st.st_size;
+  if (f->size == 0) { close(fd); delete f; return -1; }
+  f->data = (char*)mmap(nullptr, f->size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (f->data == MAP_FAILED) { delete f; return -1; }
+
+  const char* p = f->data;
+  const char* end = f->data + f->size;
+  // skip leading non-numeric (header) rows
+  while (p < end) {
+    const char* nl = (const char*)memchr(p, '\n', end - p);
+    const char* rowend = nl ? nl : end;
+    if (row_is_numeric(p, rowend)) break;
+    f->skipped_header++;
+    if (!nl) { p = end; break; }
+    p = nl + 1;
+  }
+  // count columns from the first data row
+  if (p < end) {
+    const char* nl = (const char*)memchr(p, '\n', end - p);
+    const char* rowend = nl ? nl : end;
+    int64_t cols = 1;
+    for (const char* q = p; q < rowend; q++)
+      if (*q == ',') cols++;
+    f->ncols = cols;
+  }
+  // record row offsets
+  while (p < end) {
+    const char* nl = (const char*)memchr(p, '\n', end - p);
+    const char* rowend = nl ? nl : end;
+    if (rowend > p && row_is_numeric(p, rowend)) {
+      f->row_offsets.push_back((size_t)(p - f->data));
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  f->nrows = (int64_t)f->row_offsets.size();
+  std::lock_guard<std::mutex> lk(g_csvs_mu);
+  g_csvs.push_back(f);
+  return (int64_t)g_csvs.size() - 1;
+}
+
+int64_t csv_nrows(int64_t h) {
+  CsvFile* f = csv_get(h);
+  return f ? f->nrows : -1;
+}
+
+int64_t csv_ncols(int64_t h) {
+  CsvFile* f = csv_get(h);
+  return f ? f->ncols : -1;
+}
+
+// parse rows [row0, row1) into out (row-major, (row1-row0) x ncols)
+int64_t csv_read(int64_t h, int64_t row0, int64_t row1, double* out,
+                 int64_t nthreads) {
+  CsvFile* f = csv_get(h);
+  if (!f) return -1;
+  if (row0 < 0 || row1 > f->nrows || row0 > row1) return -1;
+  const int64_t n = row1 - row0;
+  const int64_t C = f->ncols;
+  if (nthreads <= 0) {
+    nthreads = (int64_t)std::thread::hardware_concurrency();
+    if (nthreads <= 0) nthreads = 1;
+  }
+  if (nthreads > n) nthreads = n > 0 ? n : 1;
+
+  std::atomic<int64_t> bad{0};
+  auto work = [&](int64_t t0, int64_t t1) {
+    for (int64_t r = t0; r < t1; r++) {
+      const char* p = f->data + f->row_offsets[row0 + r];
+      const char* end = f->data + f->size;
+      double* orow = out + (size_t)r * C;
+      for (int64_t c = 0; c < C; c++) {
+        while (p < end && (*p == ' ' || *p == '\t')) p++;
+        if (p >= end || *p == '\n' || *p == ',' || *p == '\r') {
+          orow[c] = NAN;  // empty cell
+        } else {
+          char* q = nullptr;
+          double v = strtod(p, &q);
+          if (q == p) { orow[c] = NAN; bad++; }
+          else { orow[c] = v; p = q; }
+        }
+        // advance to next comma / newline
+        while (p < end && *p != ',' && *p != '\n') p++;
+        if (p < end && *p == ',') p++;
+      }
+    }
+  };
+  if (nthreads <= 1) {
+    work(0, n);
+  } else {
+    std::vector<std::thread> ts;
+    int64_t per = (n + nthreads - 1) / nthreads;
+    for (int64_t t = 0; t < nthreads; t++) {
+      int64_t a = t * per, b = std::min(n, a + per);
+      if (a >= b) break;
+      ts.emplace_back(work, a, b);
+    }
+    for (auto& t : ts) t.join();
+  }
+  return bad.load();
+}
+
+void csv_close(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_csvs_mu);
+  if (h < 0 || h >= (int64_t)g_csvs.size() || !g_csvs[h]) return;
+  CsvFile* f = g_csvs[h];
+  munmap(f->data, f->size);
+  delete f;
+  g_csvs[h] = nullptr;
+}
+
+// --------------------------------------------- sparse assembly / prescale
+//
+// COO -> CSR with duplicate summation: the host-side half of model
+// lowering (`CompiledLP` keeps COO index groups; large multiperiod models
+// assemble faster natively). rows/cols int64 (nnz), vals double.
+// out_* must be sized: indptr (nrows+1), indices (nnz), data (nnz).
+// Returns the deduplicated nnz.
+
+int64_t coo_to_csr(int64_t nrows, int64_t nnz, const int64_t* rows,
+                   const int64_t* cols, const double* vals,
+                   int64_t* out_indptr, int64_t* out_indices,
+                   double* out_data) {
+  std::vector<int64_t> count(nrows + 1, 0);
+  for (int64_t i = 0; i < nnz; i++) {
+    if (rows[i] < 0 || rows[i] >= nrows) return -1;
+    count[rows[i] + 1]++;
+  }
+  for (int64_t r = 0; r < nrows; r++) count[r + 1] += count[r];
+  std::vector<int64_t> pos(count.begin(), count.end() - 1);
+  std::vector<int64_t> ci(nnz);
+  std::vector<double> cv(nnz);
+  for (int64_t i = 0; i < nnz; i++) {
+    int64_t p = pos[rows[i]]++;
+    ci[p] = cols[i];
+    cv[p] = vals[i];
+  }
+  // sort each row by column (insertion sort: rows are short in our LPs)
+  // and sum duplicates
+  int64_t w = 0;
+  out_indptr[0] = 0;
+  for (int64_t r = 0; r < nrows; r++) {
+    int64_t a = count[r], b = count[r + 1];
+    for (int64_t i = a + 1; i < b; i++) {
+      int64_t c = ci[i];
+      double v = cv[i];
+      int64_t j = i - 1;
+      while (j >= a && ci[j] > c) {
+        ci[j + 1] = ci[j];
+        cv[j + 1] = cv[j];
+        j--;
+      }
+      ci[j + 1] = c;
+      cv[j + 1] = v;
+    }
+    for (int64_t i = a; i < b; i++) {
+      if (w > out_indptr[r] && out_indices[w - 1] == ci[i]) {
+        out_data[w - 1] += cv[i];
+      } else {
+        out_indices[w] = ci[i];
+        out_data[w] = cv[i];
+        w++;
+      }
+    }
+    out_indptr[r + 1] = w;
+  }
+  return w;
+}
+
+// Ruiz equilibration on CSR: returns diagonal scalings r (nrows), c (ncols)
+// with R A C having ~unit row/col infinity norms. Mirrors
+// `solvers/ipm.py:_ruiz_scaling` for host-side presolve of very large LPs.
+void ruiz_scale_csr(int64_t nrows, int64_t ncols, const int64_t* indptr,
+                    const int64_t* indices, const double* data,
+                    int64_t iters, double* r, double* c) {
+  for (int64_t i = 0; i < nrows; i++) r[i] = 1.0;
+  for (int64_t j = 0; j < ncols; j++) c[j] = 1.0;
+  std::vector<double> cmax(ncols);
+  for (int64_t it = 0; it < iters; it++) {
+    for (int64_t i = 0; i < nrows; i++) {
+      double m = 0.0;
+      for (int64_t k = indptr[i]; k < indptr[i + 1]; k++) {
+        double v = fabs(data[k] * r[i] * c[indices[k]]);
+        if (v > m) m = v;
+      }
+      if (m > 0) r[i] /= sqrt(m);
+    }
+    std::fill(cmax.begin(), cmax.end(), 0.0);
+    for (int64_t i = 0; i < nrows; i++) {
+      for (int64_t k = indptr[i]; k < indptr[i + 1]; k++) {
+        double v = fabs(data[k] * r[i] * c[indices[k]]);
+        if (v > cmax[indices[k]]) cmax[indices[k]] = v;
+      }
+    }
+    for (int64_t j = 0; j < ncols; j++)
+      if (cmax[j] > 0) c[j] /= sqrt(cmax[j]);
+  }
+}
+
+// ------------------------------------------------- sweep result store
+//
+// Append-only binary record store for sweep checkpointing — the native
+// analogue of the reference's per-point `result_*.json` files
+// (`run_pricetaker_wind_PEM.py:43-50`). Records: [magic u32][key u64]
+// [len u64][payload f64 x len][crc u32]. Torn tails (crashed writers) are
+// ignored on read.
+
+static uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
+  crc = ~crc;
+  while (n--) {
+    crc ^= *p++;
+    for (int k = 0; k < 8; k++)
+      crc = (crc >> 1) ^ (0xEDB88320u & (~(crc & 1) + 1));
+  }
+  return ~crc;
+}
+
+static const uint32_t kMagic = 0xD15BA7C5u;
+
+int64_t store_append(const char* path, uint64_t key, const double* data,
+                     uint64_t len) {
+  FILE* fp = fopen(path, "ab");
+  if (!fp) return -1;
+  uint32_t crc = 0;
+  crc = crc32_update(crc, (const uint8_t*)&key, sizeof key);
+  crc = crc32_update(crc, (const uint8_t*)data, len * sizeof(double));
+  int64_t ok = 1;
+  ok &= fwrite(&kMagic, sizeof kMagic, 1, fp) == 1;
+  ok &= fwrite(&key, sizeof key, 1, fp) == 1;
+  ok &= fwrite(&len, sizeof len, 1, fp) == 1;
+  ok &= len == 0 || fwrite(data, sizeof(double), len, fp) == len;
+  ok &= fwrite(&crc, sizeof crc, 1, fp) == 1;
+  fclose(fp);
+  return ok ? 0 : -1;
+}
+
+// scan: fills keys[] and lens[] up to cap entries; returns count (latest
+// record wins on duplicate keys only at the python layer).
+int64_t store_scan(const char* path, uint64_t* keys, uint64_t* lens,
+                   int64_t cap) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return 0;
+  int64_t n = 0;
+  for (;;) {
+    uint32_t magic;
+    uint64_t key, len;
+    if (fread(&magic, sizeof magic, 1, fp) != 1) break;
+    if (magic != kMagic) break;
+    if (fread(&key, sizeof key, 1, fp) != 1) break;
+    if (fread(&len, sizeof len, 1, fp) != 1) break;
+    std::vector<double> buf(len);
+    if (len && fread(buf.data(), sizeof(double), len, fp) != len) break;
+    uint32_t crc;
+    if (fread(&crc, sizeof crc, 1, fp) != 1) break;
+    uint32_t want = 0;
+    want = crc32_update(want, (const uint8_t*)&key, sizeof key);
+    want = crc32_update(want, (const uint8_t*)buf.data(), len * sizeof(double));
+    if (want != crc) break;  // torn/corrupt tail
+    if (n < cap) { keys[n] = key; lens[n] = len; }
+    n++;
+  }
+  fclose(fp);
+  return n;
+}
+
+// single-pass bulk read: all valid records' payloads concatenated into
+// `out` (caller sizes it from store_scan's lens); returns doubles written.
+int64_t store_read_all(const char* path, double* out, uint64_t cap) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return -1;
+  uint64_t w = 0;
+  for (;;) {
+    uint32_t magic;
+    uint64_t key, len;
+    if (fread(&magic, sizeof magic, 1, fp) != 1) break;
+    if (magic != kMagic) break;
+    if (fread(&key, sizeof key, 1, fp) != 1) break;
+    if (fread(&len, sizeof len, 1, fp) != 1) break;
+    std::vector<double> buf(len);
+    if (len && fread(buf.data(), sizeof(double), len, fp) != len) break;
+    uint32_t crc;
+    if (fread(&crc, sizeof crc, 1, fp) != 1) break;
+    uint32_t want = 0;
+    want = crc32_update(want, (const uint8_t*)&key, sizeof key);
+    want = crc32_update(want, (const uint8_t*)buf.data(), len * sizeof(double));
+    if (want != crc) break;
+    if (w + len > cap) break;  // caller under-sized: stop cleanly
+    memcpy(out + w, buf.data(), len * sizeof(double));
+    w += len;
+  }
+  fclose(fp);
+  return (int64_t)w;
+}
+
+}  // extern "C"

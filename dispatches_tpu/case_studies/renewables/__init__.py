@@ -1,6 +1,12 @@
 """Renewables case study — the analogue of
 `dispatches/case_studies/renewables_case/`."""
 
+from .horizon import (
+    WindBatteryChunk,
+    build_chunk,
+    coarse_boundary_states,
+    wind_battery_horizon_solve,
+)
 from .conceptual_design import (
     ConceptualDesignInputs,
     conceptual_design_dynamic_RE,

@@ -1,12 +1,7 @@
 """Parallelism layer: scenario sharding (DP analogue) + time-axis horizon
-decomposition (SP/CP analogue) over `jax.sharding.Mesh` (SURVEY.md §2.7)."""
+decomposition (SP/CP analogue) over `jax.sharding.Mesh` (SURVEY.md §2.7).
+Case-specific horizon drivers live with their case studies (e.g.
+`case_studies/renewables/horizon.py`)."""
 
 from .mesh import pad_batch, scenario_mesh, solve_lp_sharded
-from .time_axis import (
-    HorizonSolution,
-    WindBatteryChunk,
-    build_chunk,
-    coarse_boundary_states,
-    solve_horizon_admm,
-    wind_battery_horizon_solve,
-)
+from .time_axis import HorizonSolution, solve_horizon_admm

@@ -8,7 +8,7 @@ scaling tricks are representative-day clustering and rolling horizons.
 
 Here the horizon is a SHARDED ARRAY AXIS: split T hours into D chunks, one
 per device. Each chunk is the same compiled LP with free boundary-state
-variables (battery SoC/throughput at the chunk edges); chunks reach
+variables (e.g. battery SoC/throughput at the chunk edges); chunks reach
 consensus on the boundary states by scaled ADMM:
 
     chunk solve:  min  c.x + (rho/2)|x_in - (z_prev - u_in)|^2
@@ -23,10 +23,14 @@ neighbours), while each chunk's interior solve stays fully local. A periodic
 horizon is the natural ring; a fixed initial state pins the wrap boundary's
 consensus value (`z_fixed`), which reproduces the reference's
 "initial SoC fixed + periodic" idiom exactly (`wind_battery_LMP.py:40-50,206`).
+
+This module is case-independent; the wind+battery horizon driver (chunk
+builder + coarse warm start) lives in
+`case_studies/renewables/horizon.py`.
 """
 from __future__ import annotations
 
-import dataclasses
+import inspect
 from functools import partial
 from typing import Dict, Optional
 
@@ -41,74 +45,12 @@ try:  # jax >= 0.8 top-level API; experimental alias kept for older jax
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from ..core.model import Model
 from ..core.program import CompiledLP, LPData
 from ..solvers.ipm import solve_lp
-from ..units.battery import BatteryStorage
-from ..units.splitter import ElectricalSplitter
-from ..units.wind import WindPower
-from ..case_studies.renewables import params as P
+
+_LP_BASE_NDIM = {"A": 2, "b": 1, "c": 1, "l": 1, "u": 1, "c0": 0}
 
 
-# ----------------------------------------------------------- chunk program
-@dataclasses.dataclass
-class WindBatteryChunk:
-    """Operational wind+battery dispatch over one horizon chunk with free
-    boundary states (fixed design — the tracking/pricetaker operating mode)."""
-
-    Tc: int
-    wind_mw: float = P.FIXED_WIND_MW
-    batt_mw: float = 25.0
-
-
-def build_chunk(spec: WindBatteryChunk):
-    """Returns (prog, idx_in, idx_out): the chunk LP and the reduced-column
-    indices of its boundary-state copies [soc, throughput]."""
-    m = Model("wb_chunk")
-    wind = WindPower(m, spec.Tc, capacity=spec.wind_mw * 1e3, cf_param="wind_cf")
-    split = ElectricalSplitter(
-        m, spec.Tc, inlet=wind.electricity_out, outlet_list=["grid", "battery"]
-    )
-    batt = BatteryStorage(
-        m,
-        spec.Tc,
-        duration=P.BATTERY_DURATION_HRS,
-        charging_eta=P.BATTERY_EFF,
-        discharging_eta=P.BATTERY_EFF,
-        degradation_rate=P.BATTERY_DEGRADATION,
-        power_capacity=spec.batt_mw * 1e3,
-        initial_soc=None,  # free boundary state
-        initial_throughput=None,  # free boundary state
-        periodic_soc=False,  # periodicity emerges from ring consensus
-    )
-    m.add_eq(batt.elec_in - split.outlets["battery"])
-
-    lmp = m.param("lmp", spec.Tc)
-    elec_sales = split.outlets["grid"] + batt.elec_out
-    revenue = 1e-3 * (lmp * elec_sales)
-    # degradation cost on the LOCAL throughput delta, matching the
-    # reference's per-block accounting (`wind_battery_LMP.py:136-142`: each
-    # hour pays deg*(tp[t] - tp[t-1]); the chunk total telescopes to
-    # tp[end] - tp[start])
-    deg_cost = (P.BATT_REP_COST_KWH * P.BATTERY_DEGRADATION) * (
-        batt.throughput[spec.Tc - 1 : spec.Tc].sum() - batt.initial_throughput
-    )
-    profit = revenue.sum() - deg_cost
-    m.expression("profit", profit)
-    m.minimize(-profit * 1e-5)
-
-    prog = m.build()
-    idx_in = np.concatenate(
-        [prog.col_index("battery.initial_soc"), prog.col_index("battery.initial_throughput")]
-    )
-    Tc = spec.Tc
-    idx_out = np.array(
-        [prog.col_index("battery.soc")[Tc - 1], prog.col_index("battery.throughput")[Tc - 1]]
-    )
-    return prog, idx_in, idx_out
-
-
-# ------------------------------------------------------------- ADMM solver
 class HorizonSolution:
     def __init__(self, x, z, primal_residual, obj):
         self.x = x  # (D, n) per-chunk solutions
@@ -136,6 +78,31 @@ def _local_solve(lp: LPData, idx_in, idx_out, a_in, a_out, w_in, w_out,
     return sol.x
 
 
+def _instantiate_chunks(prog: CompiledLP, chunk_params, D) -> LPData:
+    """Chunk-batched LP tensors. When no parameter enters A (the usual
+    time-structured case: prices/CFs land in b and c), A/l/u stay UNBATCHED
+    and only b/c/c0 carry the chunk axis — D-fold less memory and the same
+    shared-A idiom as `solve_lp_batch`/`solve_lp_sharded`."""
+    def inst(i):
+        return prog.instantiate({n: v[i] for n, v in chunk_params.items()})
+
+    if prog.A_pgroups:
+        return jax.vmap(inst)(jnp.arange(D))
+    lp0 = inst(0)
+    # jit so the per-chunk A/l/u construction is dead-code-eliminated
+    b, c, c0 = jax.jit(
+        jax.vmap(lambda i: (lambda lp: (lp.b, lp.c, lp.c0))(inst(i)))
+    )(jnp.arange(D))
+    return LPData(A=lp0.A, b=b, c=c, l=lp0.l, u=lp0.u, c0=c0)
+
+
+def _lp_axes(lp_b: LPData):
+    return LPData(*(
+        0 if getattr(lp_b, n).ndim == _LP_BASE_NDIM[n] + 1 else None
+        for n in LPData._fields
+    ))
+
+
 def solve_horizon_admm(
     prog: CompiledLP,
     chunk_params: Dict[str, jnp.ndarray],  # each (D, ...) chunk-stacked
@@ -154,7 +121,9 @@ def solve_horizon_admm(
 ) -> HorizonSolution:
     """Ring-ADMM over horizon chunks. With `mesh`, chunks shard one-per-device
     via `shard_map` and the boundary exchange is a `ppermute` over ICI; with
-    no mesh the same math runs as a `vmap` (single-device testing).
+    no mesh the same math runs as a `vmap` (single-device testing). Both
+    paths run the SAME iteration body, parameterized only by the ring-shift
+    and global-sum operators.
 
     `z_fixed` pins the consensus state of the wrap boundary (chunk D-1 end ==
     chunk 0 start) — the fixed-initial-SoC + periodic idiom of the reference.
@@ -167,157 +136,137 @@ def solve_horizon_admm(
     cannot discover profitable long-range storage patterns from a cold start
     (the myopic per-chunk optimum is a fixed point to working precision), so
     for storage-arbitrage horizons pass boundary states from a cheap
-    time-aggregated monolithic solve (see `wind_battery_horizon_solve`,
-    which lands within ~0.3%% of the exact monolithic optimum in tests).
+    time-aggregated monolithic solve (see
+    `case_studies/renewables/horizon.py:wind_battery_horizon_solve`, which
+    lands within ~0.3-1%% of the exact monolithic optimum in tests).
+
+    `adapt_rho` enables residual-balancing rho updates (Boyd et al. §3.4.1)
+    — useful from cold starts; disable it when a good `z0` is supplied (the
+    rho ramp perturbs the warm start).
     """
     D = next(iter(chunk_params.values())).shape[0]
     k = len(idx_in)
-    lp_b = jax.vmap(lambda i: prog.instantiate(
-        {n: v[i] for n, v in chunk_params.items()}
-    ))(jnp.arange(D))
+    lp_b = _instantiate_chunks(prog, chunk_params, D)
+    dtype = lp_b.c.dtype
 
     mask_np = np.ones((D, k), bool)
     if wrap_free is not None:
         if z_fixed is None:
             raise ValueError("wrap_free requires z_fixed (a pinned start state)")
         mask_np[D - 1, np.asarray(wrap_free)] = False
-    mask_out = jnp.asarray(mask_np)
+    mask_all = jnp.asarray(mask_np)
+    z_init_all = (
+        jnp.zeros((D, k), dtype) if z0 is None else jnp.asarray(z0, dtype)
+    )
 
     solve_one = partial(
         _local_solve, idx_in=idx_in, idx_out=idx_out,
         tol=nlp_tol, iters=nlp_iters,
     )
+    lp_axes = _lp_axes(lp_b)
 
-    def weights(rho_t):
-        w = rho_t
-        w_in = jnp.full((D, k), 1.0, lp_b.c.dtype) * w
-        w_out = jnp.where(mask_out, w, 0.0)
-        return w_in, w_out
+    def make_admm(lp_loc, shift_prev, shift_next, gsum, pin_z, mask, z_init):
+        """The single ADMM iteration body. `shift_prev(v)[d] = v[d-1]`,
+        `shift_next(v)[d] = v[d+1]` around the chunk ring; `gsum` reduces a
+        local array to the global scalar sum; `pin_z` overwrites the wrap
+        boundary's consensus row when z_fixed is set."""
 
-    def admm_vmap(lp_b):
-        # residual-balancing adaptive rho (Boyd et al. §3.4.1): the boundary
-        # states are physically scaled (1e4-1e5 kWh) while objective
-        # sensitivities are ~1e-6/kWh, so no fixed rho gets both tight
-        # consensus and dual convergence; rho self-tunes and the scaled
-        # duals rescale with it
-        def body(_, st):
-            z, u_in, u_out, rho_t = st
-            w_in, w_out = weights(rho_t)
-            a_in = jnp.roll(z, 1, axis=0) - u_in  # z_{d-1}
-            a_out = z - u_out
-            xs = jax.vmap(
-                lambda lp, ai, ao, wi, wo: solve_one(
-                    lp, a_in=ai, a_out=ao, w_in=wi, w_out=wo
-                )
-            )(lp_b, a_in, a_out, w_in, w_out)
-            outs = xs[:, idx_out]
-            ins = xs[:, idx_in]
-            z_new = 0.5 * (outs + u_out + jnp.roll(ins + u_in, -1, axis=0))
-            if z_fixed is not None:
-                z_new = z_new.at[-1].set(jnp.asarray(z_fixed, z_new.dtype))
-            u_out = jnp.where(mask_out, u_out + outs - z_new, 0.0)
-            u_in = u_in + ins - jnp.roll(z_new, 1, axis=0)
-            r = jnp.sqrt(
-                jnp.sum(jnp.where(mask_out, (outs - z_new) ** 2, 0.0))
-                + jnp.sum((ins - jnp.roll(z_new, 1, axis=0)) ** 2)
-            )
-            s = rho_t * jnp.sqrt(jnp.sum((z_new - z) ** 2))
-            f = jnp.where(r > 10.0 * s, 2.0, jnp.where(s > 10.0 * r, 0.5, 1.0))
-            f = f if adapt_rho else 1.0
-            return (z_new, u_in / f, u_out / f, rho_t * f)
-
-        z_init = (
-            jnp.zeros((D, k), lp_b.c.dtype)
-            if z0 is None
-            else jnp.asarray(z0, lp_b.c.dtype)
-        )
-        zeros = jnp.zeros((D, k), lp_b.c.dtype)
-        st = jax.lax.fori_loop(
-            0, admm_iters, body,
-            (z_init, zeros, zeros, jnp.asarray(rho, lp_b.c.dtype)),
-        )
-        z, u_in, u_out, rho_t = st
-        w_in, w_out = weights(rho_t)
-        a_in = jnp.roll(z, 1, axis=0) - u_in
-        a_out = z - u_out
-        xs = jax.vmap(
-            lambda lp, ai, ao, wi, wo: solve_one(
-                lp, a_in=ai, a_out=ao, w_in=wi, w_out=wo
-            )
-        )(lp_b, a_in, a_out, w_in, w_out)
-        return xs, z
-
-    def admm_sharded(lp_b, mask_sh, z_init_sh):
-        axis = chunk_axis
-        fwd = [(i, (i + 1) % D) for i in range(D)]  # z_d -> device d+1
-        bwd = [(i, (i - 1) % D) for i in range(D)]
-
-        def local_solves(lp_b, a_in, a_out, rho_t):
-            w = rho_t
-            w_in = jnp.full(a_in.shape, 1.0, lp_b.c.dtype) * w
-            w_out = jnp.where(mask_sh, w, 0.0)
+        def local_solves(a_in, a_out, rho_t):
+            w_in = jnp.full(a_in.shape, 1.0, dtype) * rho_t
+            w_out = jnp.where(mask, rho_t, 0.0)
             return jax.vmap(
                 lambda lp, ai, ao, wi, wo: solve_one(
                     lp, a_in=ai, a_out=ao, w_in=wi, w_out=wo
-                )
-            )(lp_b, a_in, a_out, w_in, w_out)
+                ),
+                in_axes=(lp_axes, 0, 0, 0, 0),
+            )(lp_loc, a_in, a_out, w_in, w_out)
 
         def body(_, st):
-            z, u_in, u_out, rho_t = st  # (1, k) local shards for D = devices
-            z_prev = jax.lax.ppermute(z, axis, fwd)
+            z, u_in, u_out, rho_t = st
+            z_prev = shift_prev(z)
             a_in = z_prev - u_in
             a_out = z - u_out
-            xs = local_solves(lp_b, a_in, a_out, rho_t)
+            xs = local_solves(a_in, a_out, rho_t)
             outs = xs[:, idx_out]
             ins = xs[:, idx_in]
-            ins_next = jax.lax.ppermute(ins + u_in, axis, bwd)
-            z_new = 0.5 * (outs + u_out + ins_next)
-            if z_fixed is not None:
-                dev = jax.lax.axis_index(axis)
-                pin = jnp.asarray(z_fixed, z_new.dtype)
-                z_new = jnp.where(dev == D - 1, pin[None, :], z_new)
-            u_out = jnp.where(mask_sh, u_out + outs - z_new, 0.0)
-            z_prev_new = jax.lax.ppermute(z_new, axis, fwd)
+            z_new = pin_z(0.5 * (outs + u_out + shift_next(ins + u_in)))
+            z_prev_new = shift_prev(z_new)
+            u_out = jnp.where(mask, u_out + outs - z_new, 0.0)
             u_in = u_in + ins - z_prev_new
-            # adaptive rho: residuals are global scalars (one psum each)
-            r = jnp.sqrt(jax.lax.psum(
-                jnp.sum(jnp.where(mask_sh, (outs - z_new) ** 2, 0.0))
-                + jnp.sum((ins - z_prev_new) ** 2), axis))
-            s = rho_t * jnp.sqrt(jax.lax.psum(jnp.sum((z_new - z) ** 2), axis))
+            # residual-balancing adaptive rho: the boundary states are
+            # physically scaled (1e4-1e5 kWh) while objective sensitivities
+            # are ~1e-6/kWh, so a fixed rho rarely fits both residuals
+            r = jnp.sqrt(gsum(
+                jnp.sum(jnp.where(mask, (outs - z_new) ** 2, 0.0))
+                + jnp.sum((ins - z_prev_new) ** 2)
+            ))
+            s = rho_t * jnp.sqrt(gsum(jnp.sum((z_new - z) ** 2)))
             f = jnp.where(r > 10.0 * s, 2.0, jnp.where(s > 10.0 * r, 0.5, 1.0))
             f = f if adapt_rho else 1.0
             return (z_new, u_in / f, u_out / f, rho_t * f)
 
-        zeros = jnp.zeros((1, k), lp_b.c.dtype)
-        st = jax.lax.fori_loop(
-            0, admm_iters, body,
-            (z_init_sh, zeros, zeros, jnp.asarray(rho, lp_b.c.dtype)),
-        )
-        z, u_in, u_out, rho_t = st
-        z_prev = jax.lax.ppermute(z, axis, fwd)
-        xs = local_solves(lp_b, z_prev - u_in, z - u_out, rho_t)
-        return xs, z
+        def run():
+            zeros = jnp.zeros_like(z_init)
+            st = jax.lax.fori_loop(
+                0, admm_iters, body,
+                (z_init, zeros, zeros, jnp.asarray(rho, dtype)),
+            )
+            z, u_in, u_out, rho_t = st
+            xs = local_solves(shift_prev(z) - u_in, z - u_out, rho_t)
+            return xs, z
+
+        return run
 
     if mesh is None:
-        xs, z = jax.jit(admm_vmap)(lp_b)
+        def pin_v(z_new):
+            if z_fixed is None:
+                return z_new
+            return z_new.at[-1].set(jnp.asarray(z_fixed, dtype))
+
+        run = make_admm(
+            lp_b,
+            shift_prev=lambda v: jnp.roll(v, 1, axis=0),
+            shift_next=lambda v: jnp.roll(v, -1, axis=0),
+            gsum=lambda v: v,
+            pin_z=pin_v,
+            mask=mask_all,
+            z_init=z_init_all,
+        )
+        xs, z = jax.jit(run)()
     else:
-        base = {"A": 2, "b": 1, "c": 1, "l": 1, "u": 1, "c0": 0}
-        in_specs = LPData(*(
-            PSpec(chunk_axis) if getattr(lp_b, n).ndim == base[n] + 1 else PSpec()
-            for n in LPData._fields
-        ))
         if D != mesh.devices.size:
             raise ValueError(
                 f"chunk count {D} must equal mesh size {mesh.devices.size} "
                 "(one chunk per device)"
             )
-        z_init = (
-            jnp.zeros((D, k), lp_b.c.dtype)
-            if z0 is None
-            else jnp.asarray(z0, lp_b.c.dtype)
-        )
-        import inspect
+        fwd = [(i, (i + 1) % D) for i in range(D)]  # z_d -> device d+1
+        bwd = [(i, (i - 1) % D) for i in range(D)]
 
+        def sharded(lp_loc, mask_loc, z_init_loc):
+            def pin_s(z_new):
+                if z_fixed is None:
+                    return z_new
+                dev = jax.lax.axis_index(chunk_axis)
+                pin = jnp.asarray(z_fixed, dtype)
+                return jnp.where(dev == D - 1, pin[None, :], z_new)
+
+            run = make_admm(
+                lp_loc,
+                shift_prev=lambda v: jax.lax.ppermute(v, chunk_axis, fwd),
+                shift_next=lambda v: jax.lax.ppermute(v, chunk_axis, bwd),
+                gsum=lambda v: jax.lax.psum(v, chunk_axis),
+                pin_z=pin_s,
+                mask=mask_loc,
+                z_init=z_init_loc,
+            )
+            return run()
+
+        in_specs = LPData(*(
+            PSpec(chunk_axis)
+            if getattr(lp_b, n).ndim == _LP_BASE_NDIM[n] + 1
+            else PSpec()
+            for n in LPData._fields
+        ))
         smap_params = inspect.signature(shard_map).parameters
         if "check_rep" in smap_params:
             kw = {"check_rep": False}
@@ -325,129 +274,23 @@ def solve_horizon_admm(
             # disable varying-manual-axes checking: the per-chunk IPM solves
             # mix shard-local constants with sharded operands by design
             kw = {"check_vma": False}
-        else:
+        else:  # pragma: no cover
             kw = {}
         fn = shard_map(
-            admm_sharded, mesh=mesh,
+            sharded, mesh=mesh,
             in_specs=(in_specs, PSpec(chunk_axis), PSpec(chunk_axis)),
             out_specs=(PSpec(chunk_axis), PSpec(chunk_axis)),
             **kw,
         )
-        xs, z = jax.jit(fn)(lp_b, mask_out, z_init)
+        xs, z = jax.jit(fn)(lp_b, mask_all, z_init_all)
 
     outs = xs[:, idx_out]
     ins = xs[:, idx_in]
     # boundary mismatch over coupled boundaries only (wrap-free coords are
     # legitimately discontinuous at the wrap)
     res = jnp.max(
-        jnp.where(mask_out, jnp.abs(outs - jnp.roll(ins, -1, axis=0)), 0.0)
+        jnp.where(mask_all, jnp.abs(outs - jnp.roll(ins, -1, axis=0)), 0.0)
     )
-    obj = jnp.sum(jax.vmap(jnp.dot)(lp_b.c, xs)) + jnp.sum(lp_b.c0)
+    cb = lp_b.c if lp_b.c.ndim == 2 else jnp.broadcast_to(lp_b.c, xs.shape)
+    obj = jnp.sum(jax.vmap(jnp.dot)(cb, xs)) + jnp.sum(lp_b.c0)
     return HorizonSolution(xs, z, res, obj)
-
-
-# ------------------------------------------------- high-level horizon driver
-def coarse_boundary_states(
-    spec: WindBatteryChunk,
-    lmp: np.ndarray,
-    wind_cf: np.ndarray,
-    D: int,
-    agg: int = 4,
-    **solver_kw,
-):
-    """Chunk-boundary [SoC, throughput] warm start from a time-aggregated
-    monolithic LP (every `agg` hours averaged into one step with dt=agg).
-    The coarse problem is 1/agg the size, solves in one IPM call, and puts
-    the boundary states within a few percent of their exact values — which
-    is what the consensus ADMM needs to escape the myopic fixed point."""
-    T = len(lmp)
-    if T % agg:
-        raise ValueError(f"horizon T={T} must be a multiple of agg={agg}")
-    Tg = T // agg
-    m = Model("wb_coarse")
-    wind = WindPower(m, Tg, capacity=spec.wind_mw * 1e3, cf_param="wind_cf")
-    split = ElectricalSplitter(
-        m, Tg, inlet=wind.electricity_out, outlet_list=["grid", "battery"]
-    )
-    batt = BatteryStorage(
-        m,
-        Tg,
-        dt=float(agg),
-        duration=P.BATTERY_DURATION_HRS,
-        charging_eta=P.BATTERY_EFF,
-        discharging_eta=P.BATTERY_EFF,
-        degradation_rate=P.BATTERY_DEGRADATION,
-        power_capacity=spec.batt_mw * 1e3,
-        initial_soc=0.0,
-        initial_throughput=0.0,
-        periodic_soc=True,
-    )
-    m.add_eq(batt.elec_in - split.outlets["battery"])
-    lmp_p = m.param("lmp", Tg)
-    rev = float(agg) * 1e-3 * (lmp_p * (split.outlets["grid"] + batt.elec_out))
-    profit = rev.sum() - (P.BATT_REP_COST_KWH * P.BATTERY_DEGRADATION) * (
-        batt.throughput[Tg - 1 : Tg].sum()
-    )
-    m.minimize(-profit * 1e-5)
-    prog = m.build()
-    lp = prog.instantiate(
-        {
-            "lmp": jnp.asarray(np.asarray(lmp).reshape(Tg, agg).mean(1)),
-            "wind_cf": jnp.asarray(np.asarray(wind_cf).reshape(Tg, agg).mean(1)),
-        }
-    )
-    sol = solve_lp(lp, **solver_kw)
-    soc = np.asarray(prog.extract("battery.soc", sol.x))
-    tp = np.asarray(prog.extract("battery.throughput", sol.x))
-    Tc = T // D
-    # coarse step containing the last hour of chunk d (end-of-chunk state)
-    bidx = [((d + 1) * Tc - 1) // agg for d in range(D)]
-    z0 = np.stack([soc[bidx], tp[bidx]], axis=1)
-    z0[-1] = 0.0  # wrap boundary is pinned anyway
-    return jnp.asarray(z0)
-
-
-def wind_battery_horizon_solve(
-    lmp: np.ndarray,
-    wind_cf: np.ndarray,
-    n_chunks: int,
-    spec: Optional[WindBatteryChunk] = None,
-    mesh: Optional[Mesh] = None,
-    admm_iters: int = 80,
-    rho: float = 1e-5,
-    agg: int = 4,
-    **admm_kw,
-) -> HorizonSolution:
-    """Solve a long wind+battery dispatch horizon by chunked consensus ADMM
-    with a coarse-LP warm start. The full pipeline of the module docstring:
-    aggregate -> warm-start boundary states -> D parallel chunk solves per
-    ADMM sweep, ppermute boundary exchange on `mesh` (or vmap without)."""
-    T = len(lmp)
-    if T % n_chunks:
-        raise ValueError(f"T={T} must divide into {n_chunks} chunks")
-    spec = spec or WindBatteryChunk(Tc=T // n_chunks)
-    if spec.Tc != T // n_chunks:
-        raise ValueError("spec.Tc inconsistent with T/n_chunks")
-    prog, idx_in, idx_out = build_chunk(spec)
-    z0 = coarse_boundary_states(spec, lmp, wind_cf, n_chunks, agg=agg)
-    cp = {
-        "lmp": jnp.asarray(np.asarray(lmp).reshape(n_chunks, spec.Tc)),
-        "wind_cf": jnp.asarray(np.asarray(wind_cf).reshape(n_chunks, spec.Tc)),
-    }
-    sol = solve_horizon_admm(
-        prog,
-        cp,
-        idx_in,
-        idx_out,
-        rho=rho,
-        admm_iters=admm_iters,
-        z_fixed=jnp.zeros(2),
-        wrap_free=np.array([False, True]),  # soc periodic, throughput cumulative
-        z0=z0,
-        adapt_rho=False,  # rho ramping perturbs a good warm start
-        mesh=mesh,
-        **admm_kw,
-    )
-    sol.program = prog
-    sol.chunk_params = cp
-    return sol

@@ -1,0 +1,9 @@
+"""Native host runtime: IO, sparse assembly, result-store (csrc bindings)."""
+
+from .native import (
+    ResultStore,
+    coo_to_csr,
+    native_available,
+    read_csv_matrix,
+    ruiz_scale,
+)

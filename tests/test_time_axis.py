@@ -13,11 +13,11 @@ import jax.numpy as jnp
 from dispatches_tpu.core.model import Model
 from dispatches_tpu.core.program import LPData
 from dispatches_tpu.parallel.mesh import scenario_mesh
-from dispatches_tpu.parallel.time_axis import (
+from dispatches_tpu.parallel.time_axis import solve_horizon_admm
+from dispatches_tpu.case_studies.renewables.horizon import (
     WindBatteryChunk,
     build_chunk,
     coarse_boundary_states,
-    solve_horizon_admm,
     wind_battery_horizon_solve,
 )
 from dispatches_tpu.case_studies.renewables import params as P
