@@ -149,10 +149,19 @@ int64_t csv_read(int64_t h, int64_t row0, int64_t row1, double* out,
         if (p >= end || *p == '\n' || *p == ',' || *p == '\r') {
           orow[c] = NAN;  // empty cell
         } else {
+          // bound the cell before strtod: mmap'd data need not be
+          // NUL-terminated (a file ending exactly at a page boundary would
+          // let strtod scan into unmapped memory)
+          char cell[64];
+          size_t cl = 0;
+          const char* q0 = p;
+          while (q0 < end && *q0 != ',' && *q0 != '\n' && cl < sizeof(cell) - 1)
+            cell[cl++] = *q0++;
+          cell[cl] = '\0';
           char* q = nullptr;
-          double v = strtod(p, &q);
-          if (q == p) { orow[c] = NAN; bad++; }
-          else { orow[c] = v; p = q; }
+          double v = strtod(cell, &q);
+          if (q == cell) { orow[c] = NAN; bad++; }
+          else { orow[c] = v; p += (q - cell); }
         }
         // advance to next comma / newline
         while (p < end && *p != ',' && *p != '\n') p++;
@@ -342,7 +351,7 @@ int64_t store_scan(const char* path, uint64_t* keys, uint64_t* lens,
 // `out` (caller sizes it from store_scan's lens); returns doubles written.
 int64_t store_read_all(const char* path, double* out, uint64_t cap) {
   FILE* fp = fopen(path, "rb");
-  if (!fp) return -1;
+  if (!fp) return 0;  // no file yet == empty store
   uint64_t w = 0;
   for (;;) {
     uint32_t magic;

@@ -1,0 +1,25 @@
+"""Market-interaction layer — the analogue of `dispatches/workflow/` +
+IDAES grid_integration (bidder/tracker/coordinator) plus the in-framework
+production-cost simulators (single-bus merit order and 5-bus DC-OPF)."""
+
+from .bidder import (
+    BatteryParametrizedBidder,
+    ParametrizedBidder,
+    PEMParametrizedBidder,
+    convert_marginal_costs_to_actual_costs,
+)
+from .coordinator import DoubleLoopCoordinator
+from .double_loop import MultiPeriodWindBattery, MultiPeriodWindPEM
+from .forecaster import Backcaster, PerfectForecaster
+from .model_data import RenewableGeneratorModelData, ThermalGeneratorModelData
+from .network import (
+    FIVE_BUS_DIR,
+    GridData,
+    ProductionCostSimulator,
+    UnitCommitment,
+    dcopf_program,
+    load_rts_format,
+    solve_hours,
+)
+from .simulator import SimpleMarket, StaticGenerator
+from .tracker import Tracker

@@ -1,0 +1,525 @@
+"""Network grid data + DC-OPF RUC/SCED — the in-framework Prescient.
+
+The reference hosts its double loop inside the external Prescient
+production-cost simulator, validated with a checked-in 5-bus RTS-GMLC-format
+dataset (`tests/test_prescient.py:55-101`, SURVEY.md §4). Here the grid
+simulator is part of the framework:
+
+- :func:`load_rts_format` parses the RTS-GMLC CSV schema (bus/branch/gen
+  tables with heat-rate cost curves, DA/RT load + renewables timeseries) —
+  a bundled synthesized 5-bus system ships in `dispatches_tpu/data/five_bus`;
+- :func:`dcopf_program` lowers the DC optimal power flow ONCE to a
+  parametric LP (params: per-bus load, renewable caps, commitment mask);
+  hours are a `vmap` batch, and bus LMPs come from the equality duals of
+  the power-balance rows — one device call clears a whole horizon;
+- :class:`UnitCommitment` is the RUC layer: merit-order commitment with
+  min-up/min-down smoothing (the MILP's LP-feasible heuristic; SURVEY.md
+  §2.6 keeps true MILP out of the TPU scope);
+- :class:`ProductionCostSimulator` runs the day-ahead RUC + hourly SCED
+  cadence against a double-loop coordinator, mirroring Prescient's plugin
+  cycle (`run_double_loop_PEM.py:193-207`).
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.model import Model
+from ..solvers.ipm import solve_lp
+
+FIVE_BUS_DIR = Path(__file__).resolve().parents[1] / "data" / "five_bus"
+MMBTU_PER_MWH = 1e-3  # heat rate BTU/kWh -> MMBtu/MWh is x1e-3
+
+
+@dataclasses.dataclass
+class ThermalUnit:
+    name: str
+    bus: int
+    p_min: float
+    p_max: float
+    min_up: int
+    min_down: int
+    ramp_mw_hr: float
+    start_cost: float
+    # piecewise marginal costs: segment widths (MW) + $/MWh, lowest first
+    seg_mw: np.ndarray
+    seg_cost: np.ndarray
+
+    @property
+    def avg_cost(self) -> float:
+        return float(np.sum(self.seg_mw * self.seg_cost) / np.sum(self.seg_mw))
+
+
+@dataclasses.dataclass
+class RenewableUnit:
+    name: str
+    bus: int
+    p_max: float
+
+
+@dataclasses.dataclass
+class GridData:
+    buses: List[int]
+    branch_from: np.ndarray  # bus indices
+    branch_to: np.ndarray
+    branch_b: np.ndarray  # susceptance 1/X
+    branch_limit: np.ndarray  # MW
+    thermal: List[ThermalUnit]
+    renewable: List[RenewableUnit]
+    da_load: np.ndarray  # (T, n_load_bus)
+    rt_load: np.ndarray
+    load_bus: List[int]
+    da_renewables: np.ndarray  # (T, n_renewable) caps
+    rt_renewables: np.ndarray
+    reserve_mw: float = 0.0
+    initial_on: Optional[Dict[str, int]] = None  # hours on(+)/off(-)
+
+    def bus_index(self, bus: int) -> int:
+        return self.buses.index(bus)
+
+
+def _read_csv(path) -> List[dict]:
+    with open(path, newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def _read_timeseries(path) -> Tuple[List[str], np.ndarray]:
+    rows = _read_csv(path)
+    cols = [c for c in rows[0] if c not in ("Year", "Month", "Day", "Period")]
+    mat = np.array([[float(r[c]) for c in cols] for r in rows])
+    return cols, mat
+
+
+def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
+    """Parse an RTS-GMLC-format directory (the reference 5-bus schema)."""
+    data_dir = Path(data_dir)
+    buses = [int(r["Bus ID"]) for r in _read_csv(data_dir / "bus.csv")]
+    bidx = {b: i for i, b in enumerate(buses)}
+
+    br = _read_csv(data_dir / "branch.csv")
+    branch_from = np.array([bidx[int(r["From Bus"])] for r in br])
+    branch_to = np.array([bidx[int(r["To Bus"])] for r in br])
+    branch_b = np.array([1.0 / float(r["X"]) for r in br])
+    branch_limit = np.array([float(r["Cont Rating"]) for r in br])
+
+    thermal, renewable = [], []
+    for r in _read_csv(data_dir / "gen.csv"):
+        p_max = float(r["PMax MW"])
+        if r["Fuel"] in ("Wind", "Solar"):
+            renewable.append(
+                RenewableUnit(r["GEN UID"], int(r["Bus ID"]), p_max)
+            )
+            continue
+        p_min = float(r["PMin MW"])
+        fuel = float(r["Fuel Price $/MMBTU"])
+        # RTS heat-rate schema: output breakpoints (fraction of pmax) with
+        # average HR at the first and incremental HR above it (BTU/kWh);
+        # sort by the numeric suffix (lexicographic puts _10 before _2)
+        num = lambda k: int(k.rsplit("_", 1)[1])
+        pct_keys = sorted(
+            (k for k in r if k.startswith("Output_pct_")), key=num
+        )
+        hr_keys = ["HR_avg_0"] + sorted(
+            (k for k in r if k.startswith("HR_incr_")), key=num
+        )
+        pcts = [float(r[k]) for k in pct_keys if r[k] not in ("", None)]
+        hrs = [float(r[k]) for k in hr_keys if r[k] not in ("", None)]
+        seg_mw, seg_cost = [], []
+        for (p0, p1), hr in zip(zip(pcts[:-1], pcts[1:]), hrs[1:]):
+            seg_mw.append((p1 - p0) * p_max)
+            seg_cost.append(hr * MMBTU_PER_MWH * fuel)
+        thermal.append(
+            ThermalUnit(
+                name=r["GEN UID"],
+                bus=int(r["Bus ID"]),
+                p_min=p_min,
+                p_max=p_max,
+                min_up=int(float(r["Min Up Time Hr"])),
+                min_down=int(float(r["Min Down Time Hr"])),
+                ramp_mw_hr=float(r["Ramp Rate MW/Min"]) * 60.0,
+                start_cost=float(r.get("Non Fuel Start Cost $", 0) or 0),
+                seg_mw=np.asarray(seg_mw),
+                seg_cost=np.asarray(seg_cost),
+            )
+        )
+
+    load_cols, da_load = _read_timeseries(data_dir / "DAY_AHEAD_load.csv")
+    _, rt_load = _read_timeseries(data_dir / "REAL_TIME_load.csv")
+    ren_cols, da_ren = _read_timeseries(data_dir / "DAY_AHEAD_renewables.csv")
+    _, rt_ren = _read_timeseries(data_dir / "REAL_TIME_renewables.csv")
+    # order renewable columns to match the gen-table order
+    order = [ren_cols.index(u.name) for u in renewable]
+    da_ren = da_ren[:, order]
+    rt_ren = rt_ren[:, order]
+
+    reserve = 0.0
+    rpath = data_dir / "reserves.csv"
+    if rpath.exists():
+        for r in _read_csv(rpath):
+            reserve += float(r.get("Requirement (MW)", 0) or 0)
+
+    init = None
+    ipath = data_dir / "initial_status.csv"
+    if ipath.exists():
+        with open(ipath) as f:
+            names = f.readline().strip().split(",")
+            hours = [float(v) for v in f.readline().strip().split(",") if v]
+        init = dict(zip(names, [int(h) for h in hours]))
+
+    return GridData(
+        buses=buses,
+        branch_from=branch_from,
+        branch_to=branch_to,
+        branch_b=branch_b,
+        branch_limit=branch_limit,
+        thermal=thermal,
+        renewable=renewable,
+        da_load=da_load,
+        rt_load=rt_load,
+        load_bus=[int(c) for c in load_cols],
+        da_renewables=da_ren,
+        rt_renewables=rt_ren,
+        reserve_mw=reserve,
+        initial_on=init,
+    )
+
+
+# ------------------------------------------------------------------ DC-OPF
+def dcopf_program(
+    grid: GridData,
+    n_participant_segments: int = 0,
+    participant_bus: Optional[int] = None,
+):
+    """Lower the single-hour DC-OPF to a parametric LP.
+
+    Params: ``load`` (n_bus,), ``ren_cap`` (n_ren,), ``commit`` (n_thermal,)
+    0/1 mask, and optionally a participant bid stack ``bid_mw``/``bid_cost``
+    (n_participant_segments,) clearing at ``participant_bus`` (a bus id from
+    the bus table; defaults to the first bus). The balance rows start at
+    ``prog.balance_row0`` in bus-table order, so
+    ``IPMSolution.y[balance_row0 : balance_row0 + n_bus]`` are the bus LMPs
+    (see :func:`solve_hours`).
+    """
+    nb = len(grid.buses)
+    m = Model("dcopf")
+    load = m.param("load", nb)
+    ren_cap = m.param("ren_cap", max(len(grid.renewable), 1))
+    commit = m.param("commit", max(len(grid.thermal), 1))
+
+    # per-segment thermal dispatch
+    seg_vars, seg_costs, seg_bus = [], [], []
+    base_vars = []  # p_min block per committed unit
+    for gi, g in enumerate(grid.thermal):
+        base = m.var(f"{g.name}.base")  # = p_min * commit
+        m.add_eq(base - commit[gi : gi + 1] * g.p_min)
+        base_vars.append(base)
+        for si, (wmw, c) in enumerate(zip(g.seg_mw, g.seg_cost)):
+            v = m.var(f"{g.name}.seg{si}")
+            m.add_le(v - commit[gi : gi + 1] * float(wmw))
+            seg_vars.append(v)
+            seg_costs.append(float(c))
+            seg_bus.append(grid.bus_index(g.bus))
+
+    ren_vars = []
+    for ri, u in enumerate(grid.renewable):
+        v = m.var(f"{u.name}.p")
+        m.add_le(v - ren_cap[ri : ri + 1])
+        ren_vars.append(v)
+
+    part_bus_i = (
+        grid.bus_index(participant_bus) if participant_bus is not None else 0
+    )
+    part_vars = []
+    if n_participant_segments:
+        bid_mw = m.param("bid_mw", n_participant_segments)
+        bid_cost = m.param("bid_cost", n_participant_segments)
+        for si in range(n_participant_segments):
+            v = m.var(f"participant.seg{si}")
+            m.add_le(v - bid_mw[si : si + 1])
+            part_vars.append((v, bid_cost))
+
+    theta = m.var("theta", nb, lb=-100.0, ub=100.0)
+    slack = m.var("shortfall", nb)  # load shed at shortfall price
+
+    # branch flows f = b*(theta_from - theta_to), limit both directions
+    # bus balance rows FIRST would require reordering; instead record their
+    # ordinal: eq rows are emitted in add_eq order — the base/commit rows
+    # came first, so balance rows start after n_thermal of them
+    balance_row0 = len(grid.thermal)  # one eq row per thermal base var
+
+    inj = [None] * nb
+    def add_inj(i, expr):
+        inj[i] = expr if inj[i] is None else inj[i] + expr
+
+    for gi, g in enumerate(grid.thermal):
+        add_inj(grid.bus_index(g.bus), base_vars[gi] + 0.0)
+    for v, c, bi in zip(seg_vars, seg_costs, seg_bus):
+        add_inj(bi, v + 0.0)
+    for u, v in zip(grid.renewable, ren_vars):
+        add_inj(grid.bus_index(u.bus), v + 0.0)
+    flows = []
+    for li in range(len(grid.branch_b)):
+        i, j = int(grid.branch_from[li]), int(grid.branch_to[li])
+        b = float(grid.branch_b[li])
+        f = m.var(f"flow{li}", lb=-float(grid.branch_limit[li]),
+                  ub=float(grid.branch_limit[li]))
+        m.add_eq(f - b * theta[i : i + 1] + b * theta[j : j + 1])
+        flows.append((f, i, j))
+    balance_row0 += len(grid.branch_b)  # flow-definition eq rows precede
+
+    # reference angle
+    m.add_eq(theta[0:1])
+    balance_row0 += 1
+
+    # bus balances (these rows' duals are the LMPs)
+    for bi_ in range(nb):
+        expr = slack[bi_ : bi_ + 1] - load[bi_ : bi_ + 1]
+        if inj[bi_] is not None:
+            expr = expr + inj[bi_]
+        if part_vars and bi_ == part_bus_i:
+            for v, _ in part_vars:
+                expr = expr + v
+        for f, i, j in flows:
+            if i == bi_:
+                expr = expr - f
+            if j == bi_:
+                expr = expr + f
+        m.add_eq(expr)
+
+    shortfall_price = 1000.0
+    cost = shortfall_price * slack.sum()
+    for v, c, _ in zip(seg_vars, seg_costs, seg_bus):
+        cost = cost + c * v
+    if part_vars:
+        bid_cost_p = part_vars[0][1]
+        for si, (v, _) in enumerate(part_vars):
+            cost = cost + bid_cost_p[si : si + 1] * v
+    m.expression("total_cost", cost)
+    m.minimize(cost)
+
+    prog = m.build()
+    prog.balance_row0 = balance_row0
+    prog.n_bus = nb
+    return prog
+
+
+def solve_hours(
+    prog,
+    grid: GridData,
+    loads_bus: np.ndarray,  # (T, n_bus)
+    ren_caps: np.ndarray,  # (T, n_ren)
+    commit: np.ndarray,  # (T, n_thermal)
+    bid_mw: Optional[np.ndarray] = None,  # (T, S)
+    bid_cost: Optional[np.ndarray] = None,
+    **solver_kw,
+):
+    """Batched DC-OPF over T hours; returns dict with dispatch, bus LMPs
+    (equality duals of the balance rows), flows and cost."""
+    T = loads_bus.shape[0]
+    loads_j = jnp.asarray(loads_bus, jnp.result_type(float))
+    ren_j = jnp.asarray(ren_caps, jnp.result_type(float))
+    commit_j = jnp.asarray(commit, jnp.result_type(float))
+    bmw_j = None if bid_mw is None else jnp.asarray(bid_mw, jnp.result_type(float))
+    bco_j = None if bid_cost is None else jnp.asarray(bid_cost, jnp.result_type(float))
+
+    def one(i):
+        p = {"load": loads_j[i], "ren_cap": ren_j[i], "commit": commit_j[i]}
+        if bmw_j is not None:
+            p["bid_mw"] = bmw_j[i]
+            p["bid_cost"] = bco_j[i]
+        lp = prog.instantiate(p)
+        sol = solve_lp(lp, **solver_kw)
+        lmp = sol.y[prog.balance_row0 : prog.balance_row0 + prog.n_bus]
+        return sol.x, lmp, sol.obj, sol.converged
+
+    xs, lmps, objs, conv = jax.vmap(one)(jnp.arange(T))
+    return {
+        "x": xs,
+        "lmp": np.asarray(lmps),
+        "cost": np.asarray(objs),
+        "converged": np.asarray(conv),
+    }
+
+
+# ----------------------------------------------------------------- RUC
+class UnitCommitment:
+    """Merit-order commitment heuristic with min-up/min-down smoothing.
+
+    The reference's RUC is a MILP solved by CBC/Xpress
+    (`prescient_options.py:32-38`); the TPU framework keeps commitment on
+    host as a deterministic heuristic (SURVEY.md §2.6: "MILP stays CPU or is
+    handled by fixed-commitment LP relaxation") and prices with the LP."""
+
+    def __init__(self, grid: GridData):
+        self.grid = grid
+
+    def commit(self, loads_total: np.ndarray, ren_total: np.ndarray):
+        """(T,) total load / renewable forecast -> (T, n_thermal) 0/1."""
+        g = self.grid
+        order = np.argsort([u.avg_cost for u in g.thermal])
+        T = len(loads_total)
+        commit = np.zeros((T, len(g.thermal)), dtype=float)
+        for t in range(T):
+            need = loads_total[t] + g.reserve_mw - ren_total[t]
+            cap = 0.0
+            for gi in order:
+                if cap >= need:
+                    break
+                commit[t, gi] = 1.0
+                cap += g.thermal[gi].p_max
+        # min-up smoothing: extend each ON run to its unit's min_up
+        for gi, u in enumerate(g.thermal):
+            on = commit[:, gi].astype(bool)
+            t = 0
+            while t < T:
+                if on[t] and (t == 0 or not on[t - 1]):
+                    commit[t : min(T, t + u.min_up), gi] = 1.0
+                    on = commit[:, gi].astype(bool)
+                t += 1
+        # min-down: a unit that turns off stays off min_down hours; if the
+        # heuristic wants it back sooner, keep it ON through the gap instead
+        for gi, u in enumerate(g.thermal):
+            on = commit[:, gi].astype(bool)
+            t = 1
+            while t < T:
+                if not on[t] and on[t - 1]:
+                    gap_end = t
+                    while gap_end < T and not on[gap_end]:
+                        gap_end += 1
+                    if gap_end < T and gap_end - t < u.min_down:
+                        commit[t:gap_end, gi] = 1.0
+                        on = commit[:, gi].astype(bool)
+                    t = gap_end
+                else:
+                    t += 1
+        return commit
+
+
+# ------------------------------------------------- production-cost simulator
+class ProductionCostSimulator:
+    """Day-ahead RUC + hourly SCED over the network — the Prescient analogue
+    hosting a double-loop participant (optional).
+
+    Results rows mirror the fields the reference's `double_loop_utils.py`
+    readers consume (day/hour, bus LMPs, dispatch, shortfall)."""
+
+    def __init__(
+        self,
+        grid: GridData,
+        participant_segments: int = 0,
+        participant_bus: Optional[int] = None,
+    ):
+        self.grid = grid
+        self.uc = UnitCommitment(grid)
+        self.prog = dcopf_program(grid, participant_segments, participant_bus)
+        self.participant_segments = participant_segments
+        self.results: List[dict] = []
+
+    def _bus_loads(self, load_row) -> np.ndarray:
+        g = self.grid
+        out = np.zeros(len(g.buses))
+        for c, v in zip(g.load_bus, load_row):
+            out[g.bus_index(c)] = v
+        return out
+
+    def simulate(self, n_days: int, coordinator=None, tracking_horizon: int = 4):
+        g = self.grid
+        for day in range(n_days):
+            h0 = day * 24
+            da_load = g.da_load[h0 : h0 + 24]
+            da_ren = g.da_renewables[h0 : h0 + 24]
+            commit = self.uc.commit(da_load.sum(1), da_ren.sum(1))
+
+            bid_mw = bid_cost = None
+            if coordinator is not None and self.participant_segments:
+                da_bids = coordinator.compute_day_ahead_bids(day)
+                bid_mw, bid_cost = self._bids_to_arrays(da_bids, coordinator)
+
+            loads = np.stack([self._bus_loads(r) for r in da_load])
+            da = solve_hours(
+                self.prog, g, loads, da_ren, commit,
+                bid_mw=bid_mw, bid_cost=bid_cost,
+            )
+            da_lmps = da["lmp"]
+
+            for hour in range(24):
+                t = h0 + hour
+                rt_loads = self._bus_loads(g.rt_load[t])[None]
+                rt_ren = g.rt_renewables[t][None]
+                bmw = bco = None
+                part_mw = 0.0
+                if coordinator is not None and self.participant_segments:
+                    rt_bids = coordinator.compute_real_time_bids(
+                        day, hour, list(da_lmps[:, 0]),
+                        self._participant_da_dispatch(da),
+                    )
+                    bmw, bco = self._bids_to_arrays(
+                        rt_bids, coordinator, single_hour=True
+                    )
+                sced = solve_hours(
+                    self.prog, g, rt_loads, rt_ren, commit[hour][None],
+                    bid_mw=bmw, bid_cost=bco,
+                )
+                if coordinator is not None and self.participant_segments:
+                    part_mw = self._participant_dispatch(sced["x"][0])
+                    coordinator.track_sced_dispatch(
+                        [part_mw] * tracking_horizon, day, hour
+                    )
+                row = {
+                    "Day": day,
+                    "Hour": hour,
+                    "Total Cost": float(sced["cost"][0]),
+                    "Shortfall [MW]": float(
+                        np.sum(np.asarray(self.prog.extract("shortfall", sced["x"][0])))
+                    ),
+                    "Participant [MW]": float(part_mw),
+                }
+                for bi, b in enumerate(g.buses):
+                    row[f"LMP bus{b}"] = float(sced["lmp"][0, bi])
+                self.results.append(row)
+        return self.results
+
+    # -- participant bid plumbing ---------------------------------------
+    def _bids_to_arrays(self, bids, coordinator, single_hour=False):
+        gen = coordinator.bidder.generator
+        S = self.participant_segments
+        hours = sorted(bids)
+        if single_hour:
+            hours = hours[:1]
+        mw = np.zeros((len(hours) if not single_hour else 1, S))
+        cost = np.full_like(mw, 1e4)
+        for r, t in enumerate(hours):
+            curve = bids[t][gen]["p_cost"]
+            for si, ((p0, c0), (p1, c1)) in enumerate(
+                zip(curve[:-1], curve[1:])
+            ):
+                if si >= S:
+                    break
+                w = p1 - p0
+                if w > 1e-9:
+                    mw[r, si] = w
+                    cost[r, si] = (c1 - c0) / w
+        if not single_hour and len(hours) < 24:
+            mw = np.vstack([mw] + [mw[-1:]] * (24 - len(hours)))
+            cost = np.vstack([cost] + [cost[-1:]] * (24 - len(hours)))
+        return mw, cost
+
+    def _participant_dispatch(self, x) -> float:
+        tot = 0.0
+        for si in range(self.participant_segments):
+            tot += float(
+                np.asarray(self.prog.extract(f"participant.seg{si}", x))
+            )
+        return tot
+
+    def _participant_da_dispatch(self, da) -> List[float]:
+        return [
+            self._participant_dispatch(np.asarray(da["x"][h]))
+            for h in range(da["x"].shape[0])
+        ]

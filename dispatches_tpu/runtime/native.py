@@ -250,21 +250,22 @@ class ResultStore:
             f.write(struct.pack("<I", crc))
 
     def _scan(self):
-        """(keys, lens) arrays over all valid records, in file order."""
-        cap = 1 << 20
-        ks = np.empty(cap, dtype=np.uint64)
-        ls = np.empty(cap, dtype=np.uint64)
-        n = self._lib.store_scan(
+        """(keys, lens) arrays over all valid records, in file order.
+        Two-phase: a cap=0 call returns the true count, then the arrays are
+        sized exactly."""
+        null = ct.POINTER(ct.c_uint64)()
+        n = self._lib.store_scan(self.path.encode(), null, null, 0)
+        if n <= 0:
+            return np.empty(0, dtype=int), np.empty(0, dtype=int)
+        ks = np.empty(n, dtype=np.uint64)
+        ls = np.empty(n, dtype=np.uint64)
+        self._lib.store_scan(
             self.path.encode(),
             ks.ctypes.data_as(ct.POINTER(ct.c_uint64)),
             ls.ctypes.data_as(ct.POINTER(ct.c_uint64)),
-            cap,
+            n,
         )
-        if n > cap:
-            raise IOError(
-                f"result store {self.path} has {n} records (> {cap} supported)"
-            )
-        return ks[:n].astype(int), ls[:n].astype(int)
+        return ks.astype(int), ls.astype(int)
 
     def keys(self):
         """Ordered list of record keys (including duplicates)."""
@@ -277,6 +278,8 @@ class ResultStore:
         out = {}
         if self._lib is not None:
             ks, ls = self._scan()
+            if len(ks) == 0:
+                return {}
             total = int(ls.sum())
             buf = np.empty(max(total, 1), dtype=np.float64)
             n = self._lib.store_read_all(

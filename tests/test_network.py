@@ -1,0 +1,160 @@
+"""5-bus grid + DC-OPF RUC/SCED tests — the `test_prescient.py:55-101`
+analogue on the bundled RTS-GMLC-format dataset, without any external
+production-cost simulator."""
+import numpy as np
+import pytest
+
+from dispatches_tpu.market.network import (
+    ProductionCostSimulator,
+    UnitCommitment,
+    dcopf_program,
+    load_rts_format,
+    solve_hours,
+)
+
+GRID = load_rts_format()
+
+
+class TestLoader:
+    def test_tables(self):
+        assert GRID.buses == [1, 2, 3, 4, 10]
+        assert len(GRID.thermal) == 4
+        assert {u.name for u in GRID.renewable} == {"4_WIND", "10_PV"}
+        assert GRID.da_load.shape == (48, 4)
+        assert GRID.da_renewables.shape == (48, 2)
+        assert GRID.reserve_mw == pytest.approx(10.0)
+
+    def test_cost_curves_convex_and_scaled(self):
+        steam = next(u for u in GRID.thermal if u.name == "10_STEAM")
+        # HR_incr_1=9500 BTU/kWh at $1.1/MMBtu -> 10.45 $/MWh first segment
+        assert steam.seg_cost[0] == pytest.approx(10.45, rel=1e-6)
+        assert np.all(np.diff(steam.seg_cost) > 0)  # convex stack
+        assert steam.seg_mw.sum() + steam.p_min == pytest.approx(steam.p_max)
+
+
+class TestDCOPF:
+    def test_uncongested_lmp_is_marginal_cost(self):
+        """All-bus LMP equals the marginal unit's segment cost when no line
+        binds (validates the equality-dual LMP extraction)."""
+        prog = dcopf_program(GRID)
+        sim = ProductionCostSimulator(GRID)
+        loads = np.stack([sim._bus_loads(GRID.da_load[h]) for h in range(4)])
+        commit = np.zeros((4, 4))
+        commit[:, 1] = 1.0  # only 10_STEAM (cheapest)
+        res = solve_hours(prog, GRID, loads, GRID.da_renewables[:4], commit)
+        assert res["converged"].all()
+        steam = GRID.thermal[1]
+        for h in range(4):
+            lmps = res["lmp"][h]
+            np.testing.assert_allclose(lmps, lmps[0], atol=1e-4)
+            # marginal price is one of the unit's segment prices (or 0 if
+            # renewables are marginal)
+            assert any(
+                abs(lmps[0] - c) < 1e-4 for c in list(steam.seg_cost) + [0.0]
+            )
+
+    def test_congestion_separates_lmps(self):
+        """Choking a line splits bus prices (congestion rent appears)."""
+        import dataclasses
+
+        tight = dataclasses.replace(
+            GRID, branch_limit=np.full_like(GRID.branch_limit, 3.0)
+        )
+        prog = dcopf_program(tight)
+        sim = ProductionCostSimulator(GRID)
+        loads = sim._bus_loads(GRID.da_load[12])[None]
+        commit = np.ones((1, 4))
+        res = solve_hours(prog, tight, loads, GRID.da_renewables[12][None], commit)
+        lmps = res["lmp"][0]
+        assert np.ptp(lmps) > 1.0  # prices differ across buses
+
+    def test_energy_balance(self):
+        prog = dcopf_program(GRID)
+        sim = ProductionCostSimulator(GRID)
+        loads = np.stack([sim._bus_loads(GRID.da_load[h]) for h in range(6)])
+        uc = UnitCommitment(GRID)
+        commit = uc.commit(GRID.da_load.sum(1)[:6], GRID.da_renewables.sum(1)[:6])
+        res = solve_hours(prog, GRID, loads, GRID.da_renewables[:6], commit)
+        for h in range(6):
+            x = np.asarray(res["x"][h])
+            gen = 0.0
+            for u in GRID.thermal:
+                gen += float(np.asarray(prog.extract(f"{u.name}.base", x)))
+                for si in range(len(u.seg_mw)):
+                    gen += float(np.asarray(prog.extract(f"{u.name}.seg{si}", x)))
+            for u in GRID.renewable:
+                gen += float(np.asarray(prog.extract(f"{u.name}.p", x)))
+            shed = float(np.sum(np.asarray(prog.extract("shortfall", x))))
+            assert gen + shed == pytest.approx(loads[h].sum(), abs=1e-4)
+
+
+class TestUnitCommitment:
+    def test_min_up_respected(self):
+        uc = UnitCommitment(GRID)
+        commit = uc.commit(GRID.da_load.sum(1), GRID.da_renewables.sum(1))
+        for gi, u in enumerate(GRID.thermal):
+            on = commit[:, gi].astype(bool)
+            runs = np.diff(np.flatnonzero(np.diff(np.r_[0, on, 0])))[::2]
+            # every completed ON run at least min_up (trailing run may clip)
+            for r in runs[:-1] if len(runs) else []:
+                assert r >= u.min_up
+
+    def test_capacity_covers_net_load(self):
+        uc = UnitCommitment(GRID)
+        commit = uc.commit(GRID.da_load.sum(1), GRID.da_renewables.sum(1))
+        pmax = np.array([u.p_max for u in GRID.thermal])
+        need = GRID.da_load.sum(1) + GRID.reserve_mw - GRID.da_renewables.sum(1)
+        cap = commit @ pmax
+        assert np.all(cap >= np.minimum(need, need.clip(min=0)) - 1e-9)
+
+
+class TestProductionCostSimulator:
+    def test_two_days_complete(self):
+        """The reference's Prescient smoke test shape: 2 simulated days
+        complete with non-empty output and no load shed."""
+        sim = ProductionCostSimulator(GRID)
+        results = sim.simulate(n_days=2)
+        assert len(results) == 48
+        shed = np.array([r["Shortfall [MW]"] for r in results])
+        np.testing.assert_allclose(shed, 0.0, atol=1e-3)
+        lmps = np.array([[r[f"LMP bus{b}"] for b in GRID.buses] for r in results])
+        assert np.all(lmps > 0)
+        assert np.all(lmps < 100)
+
+    def test_double_loop_participant(self, ):
+        """Full 5-bus double loop: wind+PEM participant bids into the
+        network market, is dispatched, and tracks its SCED signal."""
+        from dispatches_tpu.market.bidder import PEMParametrizedBidder
+        from dispatches_tpu.market.coordinator import DoubleLoopCoordinator
+        from dispatches_tpu.market.double_loop import MultiPeriodWindPEM
+        from dispatches_tpu.market.forecaster import PerfectForecaster
+        from dispatches_tpu.market.model_data import RenewableGeneratorModelData
+        from dispatches_tpu.market.tracker import Tracker
+
+        wind_cfs = np.clip(
+            0.5 + 0.3 * np.sin(np.arange(48) / 5.0), 0.0, 1.0
+        )
+        md = RenewableGeneratorModelData(
+            gen_name="309_WIND_1", bus="1", p_min=0.0, p_max=50.0,
+        )
+        fc = PerfectForecaster(
+            {"309_WIND_1-DACF": wind_cfs, "309_WIND_1-RTCF": wind_cfs}
+        )
+        mp = MultiPeriodWindPEM(
+            model_data=md,
+            wind_capacity_factors=wind_cfs,
+            wind_pmax_mw=50,
+            pem_pmax_mw=10,
+        )
+        bidder = PEMParametrizedBidder(
+            mp, day_ahead_horizon=24, real_time_horizon=4, forecaster=fc,
+            pem_marginal_cost=25.0, pem_mw=10,
+        )
+        tracker = Tracker(mp, tracking_horizon=4, n_tracking_hour=1)
+        coord = DoubleLoopCoordinator(bidder, tracker)
+        sim = ProductionCostSimulator(GRID, participant_segments=2)
+        results = sim.simulate(n_days=2, coordinator=coord)
+        assert len(results) == 48
+        part = np.array([r["Participant [MW]"] for r in results])
+        assert part.max() > 1.0  # cheap wind gets dispatched
+        assert len(mp.result_list) > 0
