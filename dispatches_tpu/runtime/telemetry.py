@@ -1,0 +1,128 @@
+"""Solve telemetry, NaN guards, and unit reporting (observability layer).
+
+SURVEY.md §5: the reference's observability is idaeslog solver tags
+(`battery.py:167-176`), per-unit `report()` stream tables
+(`battery.py:178-233`), and DoF statistics. The TPU-native analogues:
+
+- :class:`SolveTelemetry` — per-solve iteration/KKT-residual records pulled
+  from `IPMSolution`/`NLPSolution` fields, with aggregate counters (the
+  "solver log" without a subprocess);
+- :func:`check_finite` — NaN/Inf guard over a pytree, the framework's
+  determinism/sanitizer hook (`jax.debug`/`config.debug_nans` is the
+  heavyweight alternative);
+- :func:`report_unit` — solution-value stream table for one unit's
+  variables (the IDAES `unit.report()` analogue).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+
+@dataclasses.dataclass
+class SolveRecord:
+    name: str
+    iterations: int
+    converged: bool
+    res_primal: float
+    res_dual: float
+    gap: float
+    wall_s: float
+    batch: int = 1
+
+
+class SolveTelemetry:
+    """Collects per-solve records; wrap solves with :meth:`observe`."""
+
+    def __init__(self):
+        self.records: List[SolveRecord] = []
+
+    def observe(self, name: str, fn, *args, **kwargs):
+        """Run `fn(*args, **kwargs)` (returning an IPM/NLP solution) and
+        record its telemetry. Returns the solution unchanged."""
+        t0 = time.perf_counter()
+        sol = fn(*args, **kwargs)
+        jax.block_until_ready(sol.x)
+        wall = time.perf_counter() - t0
+        conv = np.asarray(sol.converged)
+        iters = np.asarray(sol.iterations)
+        self.records.append(
+            SolveRecord(
+                name=name,
+                iterations=int(iters.max()),
+                converged=bool(conv.all()),
+                res_primal=float(np.max(np.asarray(sol.res_primal))),
+                res_dual=float(np.max(np.asarray(sol.res_dual))),
+                gap=float(np.max(np.asarray(sol.gap))),
+                wall_s=wall,
+                batch=int(conv.size),
+            )
+        )
+        return sol
+
+    def summary(self) -> dict:
+        if not self.records:
+            return {"solves": 0}
+        return {
+            "solves": len(self.records),
+            "total_batch": sum(r.batch for r in self.records),
+            "all_converged": all(r.converged for r in self.records),
+            "max_iterations": max(r.iterations for r in self.records),
+            "worst_gap": max(r.gap for r in self.records),
+            "total_wall_s": sum(r.wall_s for r in self.records),
+        }
+
+    def __str__(self):
+        lines = [
+            f"{'solve':<24}{'batch':>6}{'iters':>7}{'conv':>6}"
+            f"{'gap':>11}{'wall [s]':>10}"
+        ]
+        for r in self.records:
+            lines.append(
+                f"{r.name:<24}{r.batch:>6}{r.iterations:>7}"
+                f"{str(r.converged):>6}{r.gap:>11.2e}{r.wall_s:>10.3f}"
+            )
+        return "\n".join(lines)
+
+
+def check_finite(tree, name: str = "value"):
+    """Raise FloatingPointError if any leaf holds NaN/Inf. Host-side guard
+    for solve outputs and checkpoint payloads."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append("/".join(str(p) for p in path) or "<leaf>")
+    if bad:
+        raise FloatingPointError(f"non-finite values in {name}: {bad}")
+    return tree
+
+
+def report_unit(
+    prog, x, unit: str, time_points: Optional[int] = 6, stream=None
+) -> Dict[str, np.ndarray]:
+    """Print an IDAES-style stream table of one unit's solution values
+    (`battery.py:178-233` `_get_stream_table_contents` analogue) and return
+    the {var: values} dict. `unit` is the variable-name prefix ("battery",
+    "pem", ...)."""
+    rows: Dict[str, np.ndarray] = {}
+    for name in prog._vars:
+        if name == unit or name.startswith(unit + "."):
+            rows[name] = np.atleast_1d(np.asarray(prog.extract(name, x)))
+    if not rows:
+        raise KeyError(f"no variables with prefix {unit!r}")
+    width = max(len(n) for n in rows) + 2
+    lines = [f"Unit report: {unit}", "=" * (width + 40)]
+    for name, vals in rows.items():
+        shown = vals[:time_points] if time_points else vals
+        body = ", ".join(f"{v:.6g}" for v in shown)
+        suffix = " ..." if time_points and len(vals) > time_points else ""
+        lines.append(f"{name:<{width}}[{body}{suffix}]")
+    text = "\n".join(lines)
+    print(text, file=stream) if stream else print(text)
+    return rows

@@ -1,0 +1,134 @@
+"""Simulation-output readers + revenue/NPV post-processing.
+
+The analogue of `renewables_case/double_loop_utils.py:21-341` and
+`utils.py:32-351`: the reference reads Prescient's CSV dumps back into
+DataFrames and computes settlement revenue/NPV summaries. Here the simulator
+is in-framework (`market/network.py` / `market/simulator.py`), so the
+readers consume its result rows (or CSVs written from them) and the same
+summaries come out as plain dicts/arrays.
+"""
+from __future__ import annotations
+
+import csv
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..case_studies.renewables import params as P
+
+
+def results_to_csv(results: List[dict], path: str):
+    """Persist simulator result rows (the Prescient output-CSV analogue)."""
+    if not results:
+        raise ValueError("no results to write")
+    keys = list(results[0])
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=keys)
+        w.writeheader()
+        w.writerows(results)
+
+
+def read_results_csv(path: str) -> List[dict]:
+    """Read rows back, parsing numerics (the `read_prescient_file` analogue,
+    `double_loop_utils.py:21-33`)."""
+    out = []
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            parsed = {}
+            for k, v in row.items():
+                try:
+                    parsed[k] = float(v)
+                except (TypeError, ValueError):
+                    parsed[k] = v
+            out.append(parsed)
+    return out
+
+
+def gen_outputs(results: List[dict], lmp_key: str = "LMP") -> Dict[str, np.ndarray]:
+    """Column-extract a participant's hourly series from simulator rows
+    (`prescient_outputs_for_gen`, `double_loop_utils.py:176-205`)."""
+    def col(key, default=0.0):
+        return np.array([float(r.get(key, default)) for r in results])
+
+    out = {
+        "lmp": col(lmp_key) if results and lmp_key in results[0] else None,
+        "dispatch_mw": col("Dispatch [MW]")
+        if results and "Dispatch [MW]" in results[0]
+        else col("Participant [MW]"),
+        "delivered_mw": col("Delivered [MW]")
+        if results and "Delivered [MW]" in results[0]
+        else None,
+    }
+    return out
+
+
+def summarize_revenue(
+    results: List[dict],
+    lmp_key: str = "LMP",
+    dispatch_key: Optional[str] = None,
+    cap_lmp: Optional[float] = None,
+) -> dict:
+    """Energy-market settlement summary (`utils.py:121-204`): sum of
+    hourly LMP x delivered MW, with the optional LMP cap of the reference's
+    `cap_rt_lmp` path."""
+    if dispatch_key is None:
+        dispatch_key = (
+            "Delivered [MW]" if results and "Delivered [MW]" in results[0]
+            else "Participant [MW]"
+        )
+    lmps = np.array([float(r[lmp_key]) for r in results])
+    if cap_lmp is not None:
+        lmps = np.minimum(lmps, cap_lmp)
+    mw = np.array([float(r[dispatch_key]) for r in results])
+    rev = float(np.sum(lmps * mw))
+    return {
+        "total_revenue": rev,
+        "mean_lmp": float(lmps.mean()),
+        "total_mwh": float(mw.sum()),
+        "capacity_factor_hours": int(len(results)),
+    }
+
+
+def summarize_h2_revenue(
+    pem_dispatch_kw: Sequence[float],
+    pem_size_kw: float,
+    h2_price_per_kg: float,
+) -> dict:
+    """H2 side revenue (`summarize_H2_revenue`, `utils.py:238-273`): PEM
+    electricity -> kg H2 at the fixed conversion -> $."""
+    from ..units.pem import DEFAULT_ELECTRICITY_TO_MOL
+
+    e = np.asarray(pem_dispatch_kw, float)
+    kg = e * DEFAULT_ELECTRICITY_TO_MOL * 3600.0 / P.H2_MOLS_PER_KG
+    return {
+        "h2_kg": float(kg.sum()),
+        "h2_revenue": float(kg.sum() * h2_price_per_kg),
+        "pem_capacity_factor": float(e.mean() / pem_size_kw) if pem_size_kw else 0.0,
+    }
+
+
+def calculate_npv(
+    annual_revenue: float,
+    wind_size_mw: float,
+    battery_size_mw: float,
+    duration: float = 4.0,
+    extant_wind: bool = True,
+    om_cost: bool = True,
+) -> dict:
+    """NPV roll-up from an annual revenue figure (`calculate_NPV`,
+    `utils.py:274-325`), using the shared cost tables (params.py)."""
+    wind_kw = wind_size_mw * 1e3
+    batt_kw = battery_size_mw * 1e3
+    capex = (P.BATT_CAP_COST_KW + P.BATT_CAP_COST_KWH * duration) * batt_kw
+    if not extant_wind:
+        capex += P.WIND_CAP_COST * wind_kw
+    fixed_om = 0.0
+    if om_cost:
+        fixed_om = P.WIND_OP_COST * wind_kw + P.BATT_OP_COST * batt_kw
+    npv = -capex + P.PA * (annual_revenue - fixed_om)
+    return {
+        "NPV": float(npv),
+        "capex": float(capex),
+        "annual_fixed_om": float(fixed_om),
+        "annualized_revenue": float(annual_revenue),
+    }

@@ -1,0 +1,195 @@
+"""Run-script entry points (the L8 layer, SURVEY.md §1).
+
+The reference exposes its workloads as `if __name__ == "__main__"` scripts
+with argparse + `multiprocessing.Pool` sweeps and per-point JSON checkpoint
+files (`run_pricetaker_wind_PEM.py`, `run_double_loop_PEM.py:39-211`). Here
+one module-level CLI covers them:
+
+    python -m dispatches_tpu.workflow.runners pricetaker --topology wind_pem \
+        --hours 168 --h2-price 2.0 2.5 3.0 --out sweep.bin
+    python -m dispatches_tpu.workflow.runners doubleloop --days 2 --out run.csv
+
+Sweeps checkpoint to the native ResultStore and SKIP already-solved points
+on re-run (the reference's `result_*.json` skip idiom,
+`run_pricetaker_wind_PEM.py:43-50`); scenario batches vmap on device instead
+of forking workers.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from ..runtime.native import ResultStore
+from .options import SimulationOptions
+
+TOPOLOGIES = ("wind_battery", "wind_pem", "wind_pem_tank_turbine")
+
+
+def run_pricetaker(
+    topology: str = "wind_pem",
+    hours: int = 168,
+    h2_prices: Optional[List[float]] = None,
+    store_path: Optional[str] = None,
+    verbose: bool = True,
+):
+    """Price-taker design sweep over H2 prices with checkpoint/skip."""
+    from ..case_studies.renewables import params as P
+    from ..case_studies.renewables.pricetaker import (
+        wind_battery_optimize,
+        wind_battery_pem_optimize,
+        wind_battery_pem_tank_turb_optimize,
+    )
+
+    data = P.load_rts303()
+    h2_prices = h2_prices or [2.0]
+    store = ResultStore(store_path) if store_path else None
+    done = set(store.keys()) if store else set()
+
+    out = []
+    for i, h2 in enumerate(h2_prices):
+        if i in done:
+            if verbose:
+                print(f"[{i}] h2=${h2}/kg: checkpointed, skipping")
+            continue
+        if topology == "wind_battery":
+            res = wind_battery_optimize(hours, data["da_lmp"], data["da_wind_cf"])
+        elif topology == "wind_pem":
+            res = wind_battery_pem_optimize(
+                hours, data["da_lmp"], data["da_wind_cf"], h2_price_per_kg=h2
+            )
+        elif topology == "wind_pem_tank_turbine":
+            res = wind_battery_pem_tank_turb_optimize(
+                hours, data["da_lmp"], data["da_wind_cf"], h2_price_per_kg=h2
+            )
+        else:
+            raise ValueError(f"topology must be one of {TOPOLOGIES}")
+        rec = {
+            "h2_price": h2,
+            "NPV": res["NPV"],
+            "annual_revenue": res["annual_revenue"],
+            "pem_kw": res.get("pem_kw", 0.0),
+            "batt_kw": res.get("batt_kw", 0.0),
+        }
+        out.append(rec)
+        if store:
+            store.append(
+                i,
+                [h2, rec["NPV"], rec["annual_revenue"], rec["pem_kw"], rec["batt_kw"]],
+            )
+        if verbose:
+            print(f"[{i}] h2=${h2}/kg: NPV ${rec['NPV']:.3e} pem {rec['pem_kw']:.0f} kW")
+    return out
+
+
+def run_double_loop(
+    opts: Optional[SimulationOptions] = None,
+    out_csv: Optional[str] = None,
+    verbose: bool = True,
+):
+    """Double-loop co-simulation on the network market (the
+    `run_double_loop_PEM.py:39-211` analogue, fully in-framework)."""
+    from ..market import (
+        DoubleLoopCoordinator,
+        PerfectForecaster,
+        PEMParametrizedBidder,
+        ProductionCostSimulator,
+        RenewableGeneratorModelData,
+        Tracker,
+        load_rts_format,
+    )
+    from ..market.double_loop import MultiPeriodWindPEM
+    from .postprocess import results_to_csv, summarize_revenue
+
+    opts = opts or SimulationOptions()
+    grid = load_rts_format(opts.data_path) if opts.data_path else load_rts_format()
+
+    T = grid.da_renewables.shape[0]
+    wind_cfs = np.clip(grid.da_renewables[:, 0] / max(
+        u.p_max for u in grid.renewable
+    ), 0.0, 1.0)
+    gen = opts.bidding_generator or grid.renewable[0].name
+    md = RenewableGeneratorModelData(
+        gen_name=gen, bus=str(grid.buses[0]), p_min=0.0, p_max=50.0
+    )
+    fc = PerfectForecaster({f"{gen}-DACF": wind_cfs, f"{gen}-RTCF": wind_cfs})
+    mp = MultiPeriodWindPEM(
+        model_data=md,
+        wind_capacity_factors=wind_cfs,
+        wind_pmax_mw=50,
+        pem_pmax_mw=10,
+    )
+    bidder = PEMParametrizedBidder(
+        mp,
+        day_ahead_horizon=min(opts.day_ahead_horizon, 24),
+        real_time_horizon=opts.real_time_horizon,
+        forecaster=fc,
+        pem_marginal_cost=25.0,
+        pem_mw=10,
+    )
+    tracker = Tracker(
+        mp,
+        tracking_horizon=opts.tracking_horizon,
+        n_tracking_hour=opts.n_tracking_hour,
+    )
+    coord = DoubleLoopCoordinator(bidder, tracker)
+    sim = ProductionCostSimulator(
+        grid,
+        participant_segments=opts.participant_segments,
+        participant_bus=opts.participant_bus,
+    )
+    results = sim.simulate(
+        n_days=opts.num_days,
+        coordinator=coord,
+        tracking_horizon=opts.tracking_horizon,
+    )
+    if out_csv:
+        results_to_csv(results, out_csv)
+    summary = summarize_revenue(
+        results, lmp_key=f"LMP bus{grid.buses[0]}",
+        dispatch_key="Participant [MW]",
+    )
+    if verbose:
+        print(json.dumps(summary))
+    return results, summary
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="dispatches-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pt = sub.add_parser("pricetaker", help="price-taker design sweep")
+    pt.add_argument("--topology", choices=TOPOLOGIES, default="wind_pem")
+    pt.add_argument("--hours", type=int, default=168)
+    pt.add_argument("--h2-price", type=float, nargs="+", default=[2.0])
+    pt.add_argument("--out", default=None, help="ResultStore checkpoint path")
+
+    dl = sub.add_parser("doubleloop", help="double-loop co-simulation")
+    dl.add_argument("--days", type=int, default=2)
+    dl.add_argument("--config", default=None, help="SimulationOptions JSON")
+    dl.add_argument("--out", default=None, help="results CSV path")
+
+    args = p.parse_args(argv)
+    if args.cmd == "pricetaker":
+        run_pricetaker(
+            topology=args.topology,
+            hours=args.hours,
+            h2_prices=args.h2_price,
+            store_path=args.out,
+        )
+    elif args.cmd == "doubleloop":
+        opts = (
+            SimulationOptions.load(args.config)
+            if args.config
+            else SimulationOptions(num_days=args.days)
+        )
+        opts.num_days = args.days
+        run_double_loop(opts, out_csv=args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

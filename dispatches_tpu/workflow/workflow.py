@@ -1,0 +1,82 @@
+"""Managed-workflow dataset stub — parity with
+`dispatches/workflow/workflow.py:23-101` (`ManagedWorkflow`, `Dataset`,
+`DatasetFactory` with "rts-gmlc" and "null" factories). The reference's
+"rts-gmlc" factory downloads the full RTS-GMLC tree via Prescient; here it
+resolves to the bundled 5-bus RTS-format dataset (zero-egress environment),
+or a caller-supplied directory.
+"""
+from __future__ import annotations
+
+import os
+
+from . import rts_gmlc
+
+
+class ManagedWorkflow:
+    def __init__(self, name: str, workspace_name: str):
+        self._name = name
+        self._workspace_name = workspace_name
+        self._datasets = {}
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def workspace_name(self):
+        return self._workspace_name
+
+    def get_dataset(self, type_: str, **kwargs):
+        """Create (or return the cached) dataset of the given type."""
+        ds = self._datasets.get(type_, None)
+        if ds is not None:
+            return ds
+        dsf = DatasetFactory(type_, workflow=self)
+        ds = dsf.create(**kwargs)
+        self._datasets[type_] = ds
+        return ds
+
+
+class Dataset:
+    def __init__(self, name: str):
+        self.name = name
+        self._meta = {}
+
+    @property
+    def meta(self):
+        return self._meta.copy()
+
+    def add_meta(self, key, value):
+        self._meta[key] = value
+
+    def __str__(self):
+        lines = ["Metadata", "--------"]
+        for key, value in self._meta.items():
+            lines.append(f"{key}:")
+            lines.append(str(value))
+        return "\n".join(lines)
+
+
+class DatasetFactory:
+    def __init__(self, type_: str, workflow=None):
+        self._wf = workflow
+        try:
+            self.create = self._get_factory_function(type_)
+        except KeyError:
+            raise KeyError(f"Cannot create dataset of type '{type_}'")
+
+    @classmethod
+    def _get_factory_function(cls, name: str):
+        if name == "rts-gmlc":
+
+            def download_fn(**kwargs):
+                rts_dir = rts_gmlc.download(**kwargs)
+                dataset = Dataset(name)
+                dataset.add_meta("directory", rts_dir)
+                dataset.add_meta("files", sorted(os.listdir(rts_dir)))
+                return dataset
+
+            return download_fn
+        if name == "null":
+            return lambda **kwargs: None
+        raise KeyError(name)

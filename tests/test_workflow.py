@@ -1,0 +1,166 @@
+"""Workflow layer tests: dataset stub, rts_gmlc resolution, options,
+post-processing, and the CLI runners (the reference's run-script layer)."""
+import json
+import numpy as np
+import pytest
+
+from dispatches_tpu.workflow import (
+    Dataset,
+    DatasetFactory,
+    ManagedWorkflow,
+    SimulationOptions,
+    calculate_npv,
+    download,
+    read_results_csv,
+    results_to_csv,
+    summarize_h2_revenue,
+    summarize_revenue,
+)
+from dispatches_tpu.workflow.runners import run_double_loop, run_pricetaker, main
+
+
+class TestWorkflowStub:
+    def test_rts_gmlc_dataset(self):
+        wf = ManagedWorkflow("test", "ws")
+        ds = wf.get_dataset("rts-gmlc")
+        assert "bus.csv" in ds.meta["files"]
+        assert wf.get_dataset("rts-gmlc") is ds  # cached
+
+    def test_null_and_unknown(self):
+        wf = ManagedWorkflow("test", "ws")
+        assert wf.get_dataset("null") is None
+        with pytest.raises(KeyError):
+            DatasetFactory("nope")
+
+    def test_download_env_and_path(self, tmp_path, monkeypatch):
+        with pytest.raises(FileNotFoundError):
+            download(tmp_path / "missing")
+        monkeypatch.setenv("DISPATCHES_RTS_GMLC_DIR", str(tmp_path))
+        assert download() == str(tmp_path)
+
+    def test_dataset_str(self):
+        ds = Dataset("d")
+        ds.add_meta("k", 1)
+        assert "k:" in str(ds)
+
+
+class TestOptions:
+    def test_roundtrip(self, tmp_path):
+        o = SimulationOptions(num_days=5, h2_price_per_kg=3.0)
+        p = tmp_path / "opts.json"
+        o.save(str(p))
+        o2 = SimulationOptions.load(str(p))
+        assert o2 == o
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError):
+            SimulationOptions.from_dict({"ruc_mipgap": 0.01})
+
+
+class TestPostprocess:
+    ROWS = [
+        {"Day": 0, "Hour": h, "LMP": 20.0 + h, "Delivered [MW]": 10.0}
+        for h in range(4)
+    ]
+
+    def test_csv_roundtrip(self, tmp_path):
+        p = tmp_path / "r.csv"
+        results_to_csv(self.ROWS, str(p))
+        back = read_results_csv(str(p))
+        assert back[2]["LMP"] == 22.0
+        assert back[0]["Delivered [MW]"] == 10.0
+
+    def test_summarize_revenue(self):
+        s = summarize_revenue(self.ROWS)
+        assert s["total_revenue"] == pytest.approx(10.0 * (20 + 21 + 22 + 23))
+        s_cap = summarize_revenue(self.ROWS, cap_lmp=21.0)
+        assert s_cap["total_revenue"] == pytest.approx(10.0 * (20 + 21 + 21 + 21))
+
+    def test_h2_revenue(self):
+        s = summarize_h2_revenue([1000.0] * 24, 1000.0, 2.0)
+        assert s["pem_capacity_factor"] == pytest.approx(1.0)
+        assert s["h2_revenue"] > 0
+
+    def test_npv_rollup(self):
+        s = calculate_npv(1e6, wind_size_mw=100, battery_size_mw=10)
+        assert s["capex"] > 0
+        assert np.isfinite(s["NPV"])
+
+
+class TestRunners:
+    def test_pricetaker_sweep_checkpoints(self, tmp_path):
+        store = tmp_path / "sweep.bin"
+        out = run_pricetaker(
+            topology="wind_battery", hours=48, h2_prices=[2.0, 2.5],
+            store_path=str(store), verbose=False,
+        )
+        assert len(out) == 2
+        # re-run skips everything
+        out2 = run_pricetaker(
+            topology="wind_battery", hours=48, h2_prices=[2.0, 2.5],
+            store_path=str(store), verbose=False,
+        )
+        assert out2 == []
+
+    def test_double_loop_runner(self, tmp_path):
+        opts = SimulationOptions(num_days=1)
+        results, summary = run_double_loop(
+            opts, out_csv=str(tmp_path / "dl.csv"), verbose=False
+        )
+        assert len(results) == 24
+        assert np.isfinite(summary["total_revenue"])
+        back = read_results_csv(str(tmp_path / "dl.csv"))
+        assert len(back) == 24
+
+    def test_cli_main(self, tmp_path, capsys):
+        rc = main(
+            ["pricetaker", "--topology", "wind_battery", "--hours", "24",
+             "--h2-price", "2.0", "--out", str(tmp_path / "s.bin")]
+        )
+        assert rc == 0
+        assert "NPV" in capsys.readouterr().out
+
+
+class TestTelemetry:
+    def test_observe_and_summary(self):
+        import jax.numpy as jnp
+        from dispatches_tpu.runtime.telemetry import SolveTelemetry
+        from dispatches_tpu.core.program import LPData
+        from dispatches_tpu.solvers.ipm import solve_lp
+
+        lp = LPData(
+            A=jnp.ones((1, 2)), b=jnp.asarray([1.0]), c=jnp.asarray([1.0, 2.0]),
+            l=jnp.zeros(2), u=jnp.full(2, jnp.inf), c0=jnp.asarray(0.0),
+        )
+        tel = SolveTelemetry()
+        sol = tel.observe("toy-lp", solve_lp, lp)
+        assert float(sol.obj) == pytest.approx(1.0, abs=1e-6)
+        s = tel.summary()
+        assert s["solves"] == 1 and s["all_converged"]
+        assert "toy-lp" in str(tel)
+
+    def test_check_finite(self):
+        from dispatches_tpu.runtime.telemetry import check_finite
+
+        check_finite({"a": np.ones(3)}, "ok")
+        with pytest.raises(FloatingPointError):
+            check_finite({"a": np.array([1.0, np.nan])}, "bad")
+
+    def test_report_unit(self, capsys):
+        import jax.numpy as jnp
+        from dispatches_tpu.case_studies.renewables.pricetaker import (
+            HybridDesign, build_pricetaker,
+        )
+        from dispatches_tpu.case_studies.renewables import params as P
+        from dispatches_tpu.runtime.telemetry import report_unit
+        from dispatches_tpu.solvers.ipm import solve_lp
+
+        d = P.load_rts303()
+        prog, _ = build_pricetaker(HybridDesign(T=24, initial_soc_fixed=0.0))
+        p = {"lmp": jnp.asarray(d["da_lmp"][:24]), "wind_cf": jnp.asarray(d["da_wind_cf"][:24])}
+        sol = solve_lp(prog.instantiate(p))
+        rows = report_unit(prog, sol.x, "battery")
+        assert "battery.soc" in rows
+        assert "Unit report: battery" in capsys.readouterr().out
+        with pytest.raises(KeyError):
+            report_unit(prog, sol.x, "nope")
