@@ -49,7 +49,10 @@ def main():
 
     lmp_weeks = data["da_lmp"].reshape(n_weeks, T)
     cf_weeks = data["da_wind_cf"].reshape(n_weeks, T)
-    rng = np.random.default_rng(0)
+    # fresh scenario draws every run: the TPU tunnel memoizes the most recent
+    # (executable, inputs) -> outputs across processes, so a fixed seed would
+    # let the timed call replay a previous process's cached result
+    rng = np.random.default_rng(time.time_ns() % (2**32))
     scale = rng.uniform(0.5, 2.0, n_scenarios)
     # batch axis = scenario x week
     lmps = (scale[:, None, None] * lmp_weeks[None]).reshape(-1, T).astype(np.float32)
@@ -68,33 +71,75 @@ def main():
         return jax.vmap(one)(lmp_b, cf_b)
 
     fn = jax.jit(solve_batch)
-    # warmup/compile
-    obj, conv, iters = fn(jnp.asarray(lmps[:B]), jnp.asarray(cfs[:B]))
-    obj.block_until_ready()
+    # warmup/compile on DIFFERENT data than the timed run — identical input
+    # buffers can be served from a cached execution on some backends, which
+    # silently turns the timed call into a no-op (round-2 lesson: 723k
+    # "solves/sec" that were really ~16)
+    warm_scale = rng.uniform(0.5, 2.0, n_scenarios)
+    warm_lmps = (warm_scale[:, None, None] * lmp_weeks[None]).reshape(-1, T)
+    obj, conv, iters = fn(jnp.asarray(warm_lmps, jnp.float32), jnp.asarray(cfs))
+    np.asarray(obj)  # block_until_ready does not block on the tunnel
+    # backend; a device->host transfer is the only real synchronization
 
     t0 = time.perf_counter()
     obj, conv, iters = fn(jnp.asarray(lmps), jnp.asarray(cfs))
-    obj.block_until_ready()
+    obj = np.asarray(obj)
+    conv = np.asarray(conv)
+    iters = np.asarray(iters)
     dt = time.perf_counter() - t0
     solves_per_sec = B / dt
-    conv_frac = float(np.mean(np.asarray(conv)))
+    conv_frac = float(np.mean(conv))
+    med_iters = float(np.median(iters))
 
-    # CPU baseline: HiGHS on a sample of the same LPs
-    n_cpu = min(8, B)
-    t0 = time.perf_counter()
-    for k in range(n_cpu):
-        lp = prog.instantiate(
-            {"lmp": jnp.asarray(lmps[k], jnp.float64), "wind_cf": jnp.asarray(cfs[k], jnp.float64)}
+    # Convergence gate: a throughput number for solves that did not converge
+    # is not a benchmark (round-1 lesson: 679k "solves/sec" at converged=0).
+    if conv_frac < 0.99:
+        print(
+            json.dumps(
+                {
+                    "metric": "BENCH GATE FAILED: weekly price-taker LP batch "
+                    f"converged={conv_frac:.3f} < 0.99 (median iters {med_iters})",
+                    "value": conv_frac,
+                    "unit": "converged fraction",
+                    "vs_baseline": 0.0,
+                }
+            )
         )
-        solve_lp_scipy(lp)
+        sys.exit(1)
+
+    # CPU baseline: warm HiGHS on a sample of the same LPs — instantiate on
+    # host first, time only the solve calls (the fair per-solve comparison;
+    # the reference additionally pays a Pyomo rebuild + subprocess per solve).
+    n_cpu = min(8, B)
+    cpu_lps = [
+        prog.instantiate(
+            {
+                "lmp": jnp.asarray(lmps[k], jnp.float64),
+                "wind_cf": jnp.asarray(cfs[k], jnp.float64),
+            }
+        )
+        for k in range(n_cpu)
+    ]
+    cpu_objs = []
+    solve_lp_scipy(cpu_lps[0])  # warm scipy/HiGHS import + first-call costs
+    t0 = time.perf_counter()
+    for lp in cpu_lps:
+        cpu_objs.append(solve_lp_scipy(lp).obj_with_offset)
     cpu_dt = (time.perf_counter() - t0) / n_cpu
     cpu_solves_per_sec = 1.0 / cpu_dt
+
+    # accuracy cross-check vs HiGHS on the sampled scenarios
+    dev_objs = np.asarray(obj)[:n_cpu]
+    rel_err = float(
+        np.max(np.abs(dev_objs - np.asarray(cpu_objs)) / (1.0 + np.abs(cpu_objs)))
+    )
 
     print(
         json.dumps(
             {
                 "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
-                f"(T=168h, batch={B}, converged={conv_frac:.3f})",
+                f"(T=168h, batch={B}, converged={conv_frac:.3f}, "
+                f"median_iters={med_iters:.0f}, max_rel_err_vs_highs={rel_err:.1e})",
                 "value": round(solves_per_sec, 3),
                 "unit": "solves/sec",
                 "vs_baseline": round(solves_per_sec / cpu_solves_per_sec, 2),

@@ -135,8 +135,11 @@ def build_hybrid(design: HybridDesign):
     return m, units
 
 
-def _npv_objective(m: Model, units, design: HybridDesign, T: int):
-    """Attach profit/annual-revenue/NPV expressions and the objective."""
+def _npv_objective(m: Model, units, design: HybridDesign, T: int, h2_price=None):
+    """Attach profit/annual-revenue/NPV expressions and the objective.
+
+    `h2_price` (optional Param) replaces the constant ``design.h2_price_per_kg``
+    so the H2 price becomes a differentiable input (solvers/diff.py)."""
     lmp = m.param("lmp", T)  # $/MWh
     re = units["re"]
     split = units["splitter"]
@@ -172,16 +175,15 @@ def _npv_objective(m: Model, units, design: HybridDesign, T: int):
         om = om + P.TURBINE_VAR_COST * turb.electricity
 
     h2_rev = None
+    price = design.h2_price_per_kg if h2_price is None else h2_price
     if "tank" in units:
         # H2 sold = pipeline outlet minus purchased feed
         # (`wind_battery_PEM_tank_turbine_LMP.py:400-405`)
         net_mol = units["tank"].outlet_to_pipeline - units["turbine"].purchased_h2
-        h2_rev = (design.h2_price_per_kg * 3600.0 / P.H2_MOLS_PER_KG) * net_mol
+        h2_rev = (3600.0 / P.H2_MOLS_PER_KG) * (price * net_mol)
     elif "pem" in units:
         # all H2 sold at the gate (`wind_battery_PEM_LMP.py:281-283`)
-        h2_rev = (
-            design.h2_price_per_kg * 3600.0 / P.H2_MOLS_PER_KG
-        ) * units["pem"].h2_flow_mol
+        h2_rev = (3600.0 / P.H2_MOLS_PER_KG) * (price * units["pem"].h2_flow_mol)
 
     profit = revenue - om
     if h2_rev is not None:
@@ -221,6 +223,37 @@ def build_pricetaker(design: HybridDesign):
     """Full build: flowsheet + objective -> CompiledLP ready to instantiate."""
     m, units = build_hybrid(design)
     _npv_objective(m, units, design, design.T)
+    return m.build(), units
+
+
+def build_pricetaker_design(design: HybridDesign):
+    """Parametric-design build for gradient-based sizing (solvers/diff.py).
+
+    Each design size stays an LP variable but is *tied* to a named parameter
+    by an equality constraint, and the H2 price becomes a parameter — so
+    ``jax.grad`` of the optimal NPV w.r.t. ``(h2_price, capacities)`` flows
+    through `instantiate` + the custom-VJP solve. This replaces the
+    reference's gradient-free rebuild-and-resolve design sweep
+    (`wind_battery_LMP.py:172-267`) with one differentiable program.
+
+    Extra params (beyond lmp/wind_cf): ``batt_kw``, ``pem_kw``, ``tank_mol``,
+    ``turb_kw``, ``wind_kw`` (only when not extant), ``h2_price`` — present
+    for the units the topology includes. Returns (CompiledLP, units).
+    """
+    d = dataclasses.replace(design, design_opt=True)
+    m, units = build_hybrid(d)
+    if "battery" in units:
+        m.add_eq(units["battery"].nameplate_power - m.param("batt_kw"))
+    if "pem" in units:
+        m.add_eq(units["pem_cap"] - m.param("pem_kw"))
+    if "tank" in units and units["tank"].tank_size is not None:
+        m.add_eq(units["tank"].tank_size - m.param("tank_mol"))
+    if "turbine" in units:
+        m.add_eq(units["turbine"].system_capacity - m.param("turb_kw"))
+    if not d.extant_wind:
+        m.add_eq(units["re"].system_capacity - m.param("wind_kw"))
+    h2p = m.param("h2_price") if "pem" in units else None
+    _npv_objective(m, units, d, d.T, h2_price=h2p)
     return m.build(), units
 
 

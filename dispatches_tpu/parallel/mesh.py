@@ -22,6 +22,39 @@ from ..core.program import LPData
 from ..solvers.ipm import IPMSolution, solve_lp
 
 
+def force_virtual_cpu_mesh(n_devices: int) -> bool:
+    """Pin this process to an `n_devices` virtual CPU mesh, BEFORE any JAX
+    backend initializes. Returns False (without mutating anything) if a
+    backend already exists — the caller must then fall back to a fresh
+    subprocess, since XLA_FLAGS is parsed once per process.
+
+    One shared implementation for tests/conftest.py and
+    `__graft_entry__.dryrun_multichip`: the ambient environment both pins
+    JAX_PLATFORMS to the TPU tunnel *and* installs a sitecustomize hook that
+    forces `jax_platforms="axon,cpu"`, so the env var and the in-process
+    config update are each required.
+    """
+    import os
+    import re
+
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        return False
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    # replace an existing (possibly different) device count rather than
+    # appending a duplicate flag the XLA parser would ignore
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags
+    ).strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    return True
+
+
 def scenario_mesh(n_devices: Optional[int] = None, axis: str = "scenario") -> Mesh:
     devs = jax.devices()
     if n_devices is not None:

@@ -1,0 +1,155 @@
+"""Differentiable optimization: design gradients through LP solves.
+
+The capability the framework exists to add over the reference's
+rebuild-and-resolve design loop (`wind_battery_LMP.py:172-267` re-solves the
+whole Pyomo model per design point, gradient-free): here `solve_lp_diff` is a
+`jax.custom_vjp` around the interior-point solve, so `jax.grad` flows through
+``params -> instantiate -> solve -> objective / solution`` and design sizing
+becomes gradient-based.
+
+Two gradient paths, both exact at the optimum:
+
+* **Optimal value (envelope theorem).** For ``V = min c.x + c0 s.t. Ax = b,
+  l <= x <= u`` with optimal primal ``x*`` and duals ``(y*, zl*, zu*)``,
+  ``dV = x*.dc + dc0 + y*.db - y*.dA.x* + zl*.dl - zu*.du``. No solution
+  sensitivity needed — robust even at degenerate vertices.
+
+* **Solution sensitivity (implicit function theorem).** Differentiating the
+  barrier KKT system at the solution gives the linear map ``d(theta) ->
+  (dx, dy)``; the reverse-mode adjoint solves one extra system with the same
+  normal-equations matrix the IPM factorizes:
+      D lam + A' nu = xbar,   A lam = -ybar
+  with ``D = zl/(x-l) + zu/(u-x) + reg``. Cotangents on the *duals* ``ybar``
+  are supported too (LMP sensitivities of the DC-OPF come out this way).
+
+Both paths are combined in one VJP: cotangents on ``obj`` use the envelope,
+cotangents on ``x``/``y`` use the adjoint KKT solve.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..core.program import LPData
+from .ipm import IPMSolution, solve_lp
+
+
+def _is_zero_ct(ct) -> bool:
+    """True for symbolic-zero cotangents (unperturbed outputs)."""
+    return isinstance(ct, jax.custom_derivatives.SymbolicZero)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def solve_lp_diff(
+    lp: LPData,
+    tol: float = 1e-8,
+    max_iter: int = 60,
+    refine_steps: int = 2,
+    bwd_reg: float = None,
+) -> IPMSolution:
+    """`solve_lp` with a custom VJP (envelope + adjoint-KKT). Drop-in for
+    gradient-based design: differentiable in ``lp`` (and hence in any
+    parameters that built it through `CompiledLP.instantiate`)."""
+    return solve_lp(lp, tol=tol, max_iter=max_iter, refine_steps=refine_steps)
+
+
+def _fwd(lp, tol, max_iter, refine_steps, bwd_reg):
+    # with symbolic_zeros=True the primal arrives wrapped in CustomVJPPrimal
+    # (.value / .perturbed) leaves
+    lp = jax.tree.map(
+        lambda v: v.value if hasattr(v, "perturbed") else v,
+        lp,
+        is_leaf=lambda v: hasattr(v, "perturbed"),
+    )
+    sol = solve_lp(lp, tol=tol, max_iter=max_iter, refine_steps=refine_steps)
+    return sol, (lp, sol)
+
+
+def _bwd(tol, max_iter, refine_steps, bwd_reg, res, ct: IPMSolution):
+    lp, sol = res
+    A, b, c, l, u, c0 = lp
+    dtype = A.dtype
+    if bwd_reg is None:
+        bwd_reg = 1e-11 if dtype == jnp.float64 else 1e-7
+    x, y = sol.x, sol.y
+    zl, zu = sol.zl, sol.zu
+
+    # gradients w.r.t. bound duals / residual diagnostics are not defined
+    # (bound duals at an LP vertex are set-valued) — fail loudly instead of
+    # silently returning zeros
+    for name in ("zl", "zu", "res_primal", "res_dual", "gap"):
+        if not _is_zero_ct(getattr(ct, name)):
+            raise NotImplementedError(
+                f"solve_lp_diff: cotangent on IPMSolution.{name} is not "
+                "supported (only obj, x, y are differentiable)"
+            )
+
+    fl = jnp.isfinite(l)
+    fu = jnp.isfinite(u)
+    need_adjoint = not (_is_zero_ct(ct.x) and _is_zero_ct(ct.y))
+    objbar = (
+        jnp.zeros((), dtype) if _is_zero_ct(ct.obj) else ct.obj.astype(dtype)
+    )
+
+    with jax.default_matmul_precision("highest"):
+        # ---- envelope contribution (cotangent on the optimal value) ----
+        gA = -objbar * jnp.outer(y, x)
+        gb = objbar * y
+        gc = objbar * x
+        gc0 = objbar
+        gl = objbar * jnp.where(fl, zl, 0.0)
+        gu = -objbar * jnp.where(fu, zu, 0.0)
+
+        # ---- adjoint-KKT contribution (cotangents on x and/or y) ----
+        # skipped entirely on the common envelope-only path (optimal_value):
+        # with symbolic_zeros the skip is static, saving the O(M^2 N + M^3)
+        # normal-equations build + Cholesky
+        if need_adjoint:
+            xbar = jnp.zeros_like(c) if _is_zero_ct(ct.x) else ct.x
+            ybar = jnp.zeros_like(b) if _is_zero_ct(ct.y) else ct.y
+            xl = jnp.where(fl, x - l, 1.0)
+            xu = jnp.where(fu, u - x, 1.0)
+            dl_w = jnp.where(fl, zl / jnp.maximum(xl, 1e-300), 0.0)
+            du_w = jnp.where(fu, zu / jnp.maximum(xu, 1e-300), 0.0)
+            d = dl_w + du_w + jnp.asarray(bwd_reg, dtype)
+            w = 1.0 / d
+            K = (A * w[None, :]) @ A.T
+            K = K + jnp.asarray(bwd_reg, dtype) * jnp.eye(
+                A.shape[0], dtype=dtype
+            )
+            cf = jax.scipy.linalg.cho_factor(K)
+            nu = jax.scipy.linalg.cho_solve(cf, A @ (w * xbar) + ybar)
+            lam = w * (xbar - A.T @ nu)
+
+            gA = gA + jnp.outer(y, lam) - jnp.outer(nu, x)
+            gb = gb + nu
+            gc = gc - lam
+            gl = gl + dl_w * lam
+            gu = gu + du_w * lam
+
+    return (LPData(A=gA, b=gb, c=gc, l=gl, u=gu, c0=gc0),)
+
+
+solve_lp_diff.defvjp(_fwd, _bwd, symbolic_zeros=True)
+
+
+# ----------------------------------------------------------------------
+# High-level front-ends over a CompiledLP
+# ----------------------------------------------------------------------
+def optimal_value(prog, params, dtype=None, **solver_kw):
+    """Differentiable optimal objective value, in the *model's* sense (a
+    maximized objective returns the maximum). ``jax.grad`` w.r.t. any entry
+    of `params` uses the envelope theorem — one solve, no resolve loop."""
+    lp = prog.instantiate(params, dtype=dtype)
+    sol = solve_lp_diff(lp, **solver_kw)
+    return prog.obj_sense * sol.obj
+
+
+def optimal_solution(prog, params, dtype=None, **solver_kw):
+    """Differentiable (solution, duals): returns the IPMSolution whose
+    ``x``/``y`` carry implicit-function-theorem VJPs. Downstream scalars
+    (e.g. ``prog.eval_expr('NPV', sol.x, params)``) are differentiable."""
+    lp = prog.instantiate(params, dtype=dtype)
+    return solve_lp_diff(lp, **solver_kw)

@@ -90,6 +90,14 @@ def solve_lp(
     primal reg above the barrier weight `z/x` of a variable far from its
     bounds visibly perturbs the solution).
     """
+    # TPU f32 matmuls default to bf16 passes, which destroys the
+    # normal-equations Cholesky (round-1 bench: 0/416 converged). Force full
+    # f32 accumulation for every dot/cholesky in the solve; no-op on CPU/f64.
+    with jax.default_matmul_precision("highest"):
+        return _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q)
+
+
+def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q):
     A0, b0, c0v, l0, u0, off0 = lp
     if reg_p is None:
         reg_p = 1e-13 if A0.dtype == jnp.float64 else 1e-8
@@ -186,12 +194,18 @@ def _solve_scaled(
         )
         return rp, rd, comp
 
+    def merit_of(rp, rd, comp, x):
+        return jnp.maximum(
+            jnp.maximum(jnp.linalg.norm(rp) / bnorm, jnp.linalg.norm(rd) / cnorm),
+            comp / (1.0 + jnp.abs(c @ x)),
+        )
+
     def cond(state):
-        x, y, zl, zu, it, done = state
+        x, y, zl, zu, best, it, done = state
         return (it < max_iter) & (~done)
 
     def body(state):
-        x, y, zl, zu, it, _ = state
+        x, y, zl, zu, best, it, _ = state
         xl = jnp.where(fl, x - l_s, 1.0)
         xu = jnp.where(fu, u_s - x, 1.0)
         zl_s = jnp.where(fl, zl, 0.0)
@@ -276,16 +290,31 @@ def _solve_scaled(
         zu_n = jnp.where(ok, zu_n, zu)
 
         rp_n, rd_n, comp_n = residuals(x_n, y_n, zl_n, zu_n)
-        objmag = 1.0 + jnp.abs(c @ x_n)
-        done = (
-            (jnp.linalg.norm(rp_n) / bnorm < tol)
-            & (jnp.linalg.norm(rd_n) / cnorm < tol)
-            & (comp_n / objmag < tol)
-        ) | (~ok)
-        return (x_n, y_n, zl_n, zu_n, it + 1, done)
+        m_n = merit_of(rp_n, rd_n, comp_n, x_n)
+        best_m, bx, by, bzl, bzu = best
+        improved = m_n < best_m
+        best = (
+            jnp.where(improved, m_n, best_m),
+            jnp.where(improved, x_n, bx),
+            jnp.where(improved, y_n, by),
+            jnp.where(improved, zl_n, bzl),
+            jnp.where(improved, zu_n, bzu),
+        )
+        # stop on convergence, numerical breakdown, or clear divergence
+        # (f32 late iterations can blow up the duals long after the best
+        # iterate was reached — round-2 TPU diagnosis: rd up to 1e2 with
+        # gap ~1e-35; the best iterate is returned, not the last)
+        diverged = m_n > 1e4 * jnp.maximum(best_m, jnp.asarray(tol, dtype))
+        done = (m_n < tol) | (~ok) | diverged
+        return (x_n, y_n, zl_n, zu_n, best, it + 1, done)
 
-    state = lax.while_loop(cond, body, (x0, y0, z0l, z0u, jnp.array(0), jnp.array(False)))
-    x, y, zl, zu, it, done = state
+    rp0, rd0, comp0 = residuals(x0, y0, z0l, z0u)
+    best0 = (merit_of(rp0, rd0, comp0, x0), x0, y0, z0l, z0u)
+    state = lax.while_loop(
+        cond, body, (x0, y0, z0l, z0u, best0, jnp.array(0), jnp.array(False))
+    )
+    _, _, _, _, best, it, done = state
+    _, x, y, zl, zu = best
     rp, rd, comp = residuals(x, y, zl, zu)
     # report convergence from actual final residuals (the loop's `done` flag
     # may also fire on the numerical-breakdown guard); accept a modestly

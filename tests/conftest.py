@@ -3,23 +3,16 @@
 Multi-chip sharding paths are validated on virtual CPU devices
 (`xla_force_host_platform_device_count`), matching how the driver dry-runs
 `__graft_entry__.dryrun_multichip`. Real-TPU benchmarking happens in bench.py,
-not in tests.
+not in tests. The platform forcing itself is shared with the dryrun entry:
+`dispatches_tpu.parallel.mesh.force_virtual_cpu_mesh`.
 """
-import os
-
-# hard-set: the ambient environment pins JAX_PLATFORMS to the single real TPU
-# backend; tests must run on the virtual CPU mesh regardless
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 import jax
 
-# the ambient axon sitecustomize installs hooks that force
-# jax_platforms="axon,cpu" regardless of the env var; override in-process
-# before any backend is initialized so tests never touch the TPU tunnel
-jax.config.update("jax_platforms", "cpu")
+from dispatches_tpu.parallel.mesh import force_virtual_cpu_mesh
+
+if not force_virtual_cpu_mesh(8):
+    raise RuntimeError(
+        "a JAX backend initialized before conftest could force the virtual "
+        "CPU mesh — tests must not touch the TPU tunnel"
+    )
 jax.config.update("jax_enable_x64", True)
