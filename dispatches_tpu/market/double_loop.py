@@ -134,7 +134,7 @@ class MultiPeriodWindPEM:
         self.result_list: List[dict] = []
 
     def build_program(self, T: int):
-        from ..units.pem import H2_MOLS_PER_KG
+        from ..units.pem import h2_value_per_kwh
 
         m = Model("wind_pem_tracking")
         wind = WindPower(m, T, capacity=self.wind_pmax_mw * 1e3, cf_param="wind_cf")
@@ -145,9 +145,9 @@ class MultiPeriodWindPEM:
         m.expression("power_output", power_out_mw)
         # negative cost = H2 revenue credit, so the tracker routes surplus
         # wind to the PEM (`wind_PEM_double_loop.py` prices H2 into tracking)
-        h2_value_per_kwh = self.h2_price_per_kg * 3600.0 / H2_MOLS_PER_KG * pem.electricity_to_mol
-        m.expression("total_cost", (-h2_value_per_kwh) * pem.electricity)
-        m.expression("h2_kg", (3600.0 / H2_MOLS_PER_KG * pem.electricity_to_mol) * pem.electricity)
+        h2_val = h2_value_per_kwh(self.h2_price_per_kg, pem.electricity_to_mol)
+        m.expression("total_cost", (-h2_val) * pem.electricity)
+        m.expression("h2_kg", pem.h2_kg_per_hr)
         self._handles = {"wind": wind, "split": split, "pem": pem}
         return m, power_out_mw
 

@@ -85,12 +85,27 @@ class Backcaster:
     def observe(self, prices):
         self._hist.extend(np.asarray(prices, dtype=float).tolist())
 
-    def forecast(self, horizon: int) -> np.ndarray:
+    def forecast(self, horizon: int, hour_of_day: Optional[int] = None) -> np.ndarray:
+        return self.forecast_scenarios(horizon, hour_of_day).mean(axis=0)
+
+    def forecast_scenarios(
+        self, horizon: int, hour_of_day: Optional[int] = None
+    ) -> np.ndarray:
+        """(n_days, horizon) price scenarios: each of the last
+        `n_historical_days` observed days is one equally-weighted scenario —
+        the IDAES Backcaster semantics feeding the stochastic `Bidder`
+        (`test_multiperiod_wind_battery_doubleloop.py:113+`).
+
+        `hour_of_day` anchors the first forecast hour; default = the hour
+        right after the observed history."""
         h = np.asarray(self._hist[-24 * self.n_historical_days :])
         days = len(h) // 24
         if days == 0:
-            return np.zeros(horizon)
+            return np.zeros((1, horizon))
         table = h[-days * 24 :].reshape(days, 24)
-        avg = table.mean(axis=0)
-        start = len(self._hist) % 24
-        return avg[(start + np.arange(horizon)) % 24]
+        # column j of `table` holds hour-of-day (a + j) % 24 where a is the
+        # hour-of-day of the table's first entry
+        a = (len(self._hist) - days * 24) % 24
+        h0 = a if hour_of_day is None else int(hour_of_day)
+        idx = (h0 - a + np.arange(horizon)) % 24
+        return table[:, idx]

@@ -157,11 +157,44 @@ def _solve_scaled(
     reg_d: float = 1e-9,
     refine_steps: int = 1,
     q: jnp.ndarray = None,
+    ops=None,
+    d_cap: float = None,
 ) -> IPMSolution:
+    """Core Mehrotra iteration. `ops`, when given, abstracts the linear
+    algebra so structured solvers (block-tridiagonal time-banded systems,
+    `solvers/structured.py`) reuse this exact loop:
+      ops = (matvec, rmatvec, make_kkt_solver) with
+        matvec(x) = A x ; rmatvec(y) = A^T y ;
+        make_kkt_solver(d) -> solve(r) approximating (A diag(1/d) A^T)^-1 r
+    (the dual regularization is the ops' responsibility). Default: dense A.
+
+    `d_cap` caps the barrier weight z/x of near-active variables. Long
+    banded factorization chains in f32 need it (uncapped spreads reach
+    1e12 and break the block Cholesky); the dense path must NOT cap (a
+    cap this tight stalls the duality gap at ~1e-4 on weekly LPs)."""
     A, b, c, l, u, c0 = lp
-    dtype = A.dtype
+    dtype = b.dtype
     q = jnp.zeros_like(c) if q is None else q
-    M, N = A.shape
+    M, N = b.shape[0], c.shape[0]
+    if ops is None:
+        def _mv(x):
+            return A @ x
+
+        def _rmv(y):
+            return A.T @ y
+
+        def _mk(d):
+            w_ = 1.0 / d
+            # absolute dual regularization: A is Ruiz-equilibrated
+            # (entries ~1), so reg_d is already in a meaningful scale
+            K = (A * w_[None, :]) @ A.T
+            K = K + jnp.asarray(reg_d, dtype) * jnp.eye(M, dtype=dtype)
+            cf = jax.scipy.linalg.cho_factor(K)
+            return lambda r: jax.scipy.linalg.cho_solve(cf, r)
+
+        matvec, rmatvec, make_kkt_solver = _mv, _rmv, _mk
+    else:
+        matvec, rmatvec, make_kkt_solver = ops
     fl = jnp.isfinite(l)
     fu = jnp.isfinite(u)
     nlu = jnp.maximum(1.0, (fl.sum() + fu.sum()).astype(dtype))
@@ -185,8 +218,8 @@ def _solve_scaled(
     z0u = jnp.where(fu, 1.0, 0.0).astype(dtype)
 
     def residuals(x, y, zl, zu):
-        rp = b - A @ x
-        rd = c + q * x - A.T @ y - zl + zu
+        rp = b - matvec(x)
+        rd = c + q * x - rmatvec(y) - zl + zu
         xl = jnp.where(fl, x - l_s, 1.0)
         xu = jnp.where(fu, u_s - x, 1.0)
         comp = jnp.sum(jnp.where(fl, xl * zl, 0.0)) + jnp.sum(
@@ -210,8 +243,8 @@ def _solve_scaled(
         xu = jnp.where(fu, u_s - x, 1.0)
         zl_s = jnp.where(fl, zl, 0.0)
         zu_s = jnp.where(fu, zu, 0.0)
-        rp = b - A @ x
-        rd = c + q * x - A.T @ y - zl_s + zu_s
+        rp = b - matvec(x)
+        rd = c + q * x - rmatvec(y) - zl_s + zu_s
         mu = (
             jnp.sum(jnp.where(fl, xl * zl, 0.0))
             + jnp.sum(jnp.where(fu, xu * zu, 0.0))
@@ -223,28 +256,25 @@ def _solve_scaled(
             + q
             + jnp.asarray(reg_p, dtype)
         )
+        if d_cap is not None:
+            d = jnp.minimum(d, jnp.asarray(d_cap, dtype))
         w = 1.0 / d
-        # absolute dual regularization: A is Ruiz-equilibrated (entries ~1),
-        # so reg_d is already in a meaningful scale; scaling by max(diag K)
-        # would explode when interior variables drive w -> 1/reg_p
-        K = (A * w[None, :]) @ A.T
-        K = K + jnp.asarray(reg_d, dtype) * jnp.eye(M, dtype=dtype)
-        cf = jax.scipy.linalg.cho_factor(K)
+        ksolve = make_kkt_solver(d)
 
         def kkt_solve(rcl, rcu):
             rhat = rd - jnp.where(fl, rcl / xl, 0.0) + jnp.where(fu, rcu / xu, 0.0)
-            rhs = rp + A @ (w * rhat)
-            dy = jax.scipy.linalg.cho_solve(cf, rhs)
-            dx = w * (A.T @ dy - rhat)
+            rhs = rp + matvec(w * rhat)
+            dy = ksolve(rhs)
+            dx = w * (rmatvec(dy) - rhat)
             # primal-residual correction: cancellation in `rhs` (rcl/xl terms
             # blow up near active bounds) leaves A dx != rp at ~sqrt(eps);
             # the correction (dy+, dx+) = (K^-1 err, w A^T dy+) restores
             # A dx ~= rp while keeping A^T dy - d dx - rhat = 0 exactly
             for _ in range(refine_steps):
-                err = rp - A @ dx
-                dy2 = jax.scipy.linalg.cho_solve(cf, err)
+                err = rp - matvec(dx)
+                dy2 = ksolve(err)
                 dy = dy + dy2
-                dx = dx + w * (A.T @ dy2)
+                dx = dx + w * (rmatvec(dy2))
             dzl = jnp.where(fl, (rcl - zl_s * dx) / xl, 0.0)
             dzu = jnp.where(fu, (rcu + zu_s * dx) / xu, 0.0)
             return dx, dy, dzl, dzu

@@ -32,3 +32,41 @@ def solve_lp_scipy(lp: LPData):
         raise RuntimeError(f"HiGHS failed: {res.status} {res.message}")
     res.obj_with_offset = res.fun + float(lp.c0)
     return res
+
+
+def solve_lp_scipy_sparse(prog, params):
+    """HiGHS on the COO instantiation — the reference cross-check for
+    year-scale LPs whose dense A would not fit in memory (8,760-h horizons,
+    `price_taker_analysis.py:181-224` scale)."""
+    import scipy.sparse as sp
+    from scipy.optimize import linprog
+
+    slp = prog.instantiate_coo(params)
+    M, N = prog.M, prog.N
+    A = sp.coo_matrix(
+        (
+            np.asarray(slp.vals, np.float64),
+            (np.asarray(slp.rows), np.asarray(slp.cols)),
+        ),
+        shape=(M, N),
+    ).tocsc()
+    l = np.asarray(slp.l, np.float64)
+    u = np.asarray(slp.u, np.float64)
+    bounds = np.stack(
+        [
+            np.where(np.isfinite(l), l, -np.inf),
+            np.where(np.isfinite(u), u, np.inf),
+        ],
+        axis=1,
+    )
+    res = linprog(
+        np.asarray(slp.c, np.float64),
+        A_eq=A,
+        b_eq=np.asarray(slp.b, np.float64),
+        bounds=bounds,
+        method="highs",
+    )
+    if res.status != 0:
+        raise RuntimeError(f"HiGHS failed: {res.status} {res.message}")
+    res.obj_with_offset = res.fun + float(slp.c0)
+    return res

@@ -1,0 +1,678 @@
+"""Block-tridiagonal (time-banded) interior-point LP solver.
+
+The year-scale monolithic solve (SURVEY.md §7 step 2, §5 "long-context"):
+dispatch LPs chain T hourly blocks with storage-state linking constraints
+(`wind_battery_LMP.py:22-50`, `price_taker_analysis.py:181-224` builds the
+8,760-block year). Ordering rows/columns by time makes the IPM's
+normal-equations matrix ``K = A W A^T`` *block tridiagonal* plus a low-rank
+border from the few design/initial-state columns that touch every period.
+
+Instead of one dense (M, M) Cholesky — O(T^3), hopeless at T=8760 — the
+factorization becomes a `lax.scan` of small per-block Cholesky factors,
+O(T · mB^3), with the border handled by a Woodbury correction of rank p
+(p = number of design columns, typically 2-5). Time steps are grouped into
+super-blocks of `block_hours` so each scan step runs MXU-sized dense ops.
+
+The Mehrotra iteration itself is shared with the dense solver —
+`solvers/ipm._solve_scaled` takes the (matvec, rmatvec, kkt-solver) ops
+defined here, so both paths run the identical algorithm.
+
+Pipeline:
+  meta = extract_time_structure(prog, T, block_hours)   # host, once
+  blp  = instantiate_banded(meta, params)               # device, jit/vmap-ok
+  sol  = solve_lp_banded(meta, blp)                     # sol.x in prog order
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.program import CompiledLP, LPData
+from .ipm import IPMSolution, _solve_scaled
+
+
+class BandedLP(NamedTuple):
+    """Time-banded standard-form LP tensors.
+
+    Row/col layout: Tb super-blocks of (mB rows, nB cols) plus p border
+    columns (design variables / free initial states that touch many
+    periods). ``As[t]`` couples block-t rows to block-(t-1) columns
+    (``As[0] = 0``)."""
+
+    Ad: jnp.ndarray  # (Tb, mB, nB) diagonal blocks
+    As: jnp.ndarray  # (Tb, mB, nB) sub-diagonal blocks
+    Bb: jnp.ndarray  # (Tb, mB, p) border columns
+    b: jnp.ndarray  # (Tb, mB)
+    c: jnp.ndarray  # (Tb, nB)
+    cb: jnp.ndarray  # (p,)
+    l: jnp.ndarray  # (Tb, nB)
+    u: jnp.ndarray  # (Tb, nB)
+    lb: jnp.ndarray  # (p,)
+    ub: jnp.ndarray  # (p,)
+    c0: jnp.ndarray  # ()
+
+
+@dataclasses.dataclass(eq=False)  # identity hash: used as a static jit arg
+class TimeStructure:
+    """Host-side scatter metadata lowering a CompiledLP into banded form.
+
+    `p` is always >= 1: a problem with no border columns gets one synthetic
+    inert column (all-zero B, bounds [0, 1], zero cost) so block shapes stay
+    uniform."""
+
+    prog: CompiledLP
+    T: int
+    block_hours: int
+    Tb: int
+    mB: int
+    nB: int
+    p: int
+    # static scatter targets (flat indices into the destination arrays)
+    diag_idx: np.ndarray
+    diag_vals: np.ndarray
+    sub_idx: np.ndarray
+    sub_vals: np.ndarray
+    bord_idx: np.ndarray
+    bord_vals: np.ndarray
+    # parametric A groups: name -> (dest, flat_idx, scale, pidx)
+    a_pgroups: list
+    b_idx: np.ndarray
+    b_vals: np.ndarray
+    b_pgroups: dict
+    c_idx: np.ndarray
+    c_vals: np.ndarray
+    cb_idx: np.ndarray
+    cb_vals: np.ndarray
+    c_pgroups: list  # (is_border, name, flat_idx, scale, pidx)
+    l_t: np.ndarray
+    u_t: np.ndarray
+    l_b: np.ndarray
+    u_b: np.ndarray
+    col_pos: np.ndarray  # reduced col -> flat position in [t-part | border]
+    row_pos_flat: np.ndarray  # original row -> flat position in (Tb*mB)
+    pad_rows: np.ndarray  # (Tb, mB) bool: padding rows (all-zero, b=0)
+
+    # ------------------------------------------------------------------
+    def instantiate(self, params: Dict[str, jnp.ndarray], dtype=None) -> BandedLP:
+        """Banded analogue of `CompiledLP.instantiate` — pure scatter ops,
+        jit/vmap-compatible over a scenario batch of parameters."""
+        prog = self.prog
+        dtype = dtype or jnp.result_type(float)
+        Tb, mB, nB, p = self.Tb, self.mB, self.nB, self.p
+
+        def fill(shape, idx, vals, pgroups):
+            a = jnp.zeros(int(np.prod(shape)), dtype)
+            a = a.at[idx].add(jnp.asarray(vals, dtype))
+            for name, scale, pidx, gi in pgroups:
+                pv = jnp.ravel(params[name]).astype(dtype)[pidx]
+                a = a.at[gi].add(jnp.asarray(scale, dtype) * pv)
+            return a.reshape(shape)
+
+        ad_pg = [
+            (k, s, pi, gi) for (dest, k, gi, s, pi) in self.a_pgroups if dest == "diag"
+        ]
+        as_pg = [
+            (k, s, pi, gi) for (dest, k, gi, s, pi) in self.a_pgroups if dest == "sub"
+        ]
+        bb_pg = [
+            (k, s, pi, gi) for (dest, k, gi, s, pi) in self.a_pgroups if dest == "bord"
+        ]
+        Ad = fill((Tb, mB, nB), self.diag_idx, self.diag_vals, ad_pg)
+        As = fill((Tb, mB, nB), self.sub_idx, self.sub_vals, as_pg)
+        Bb = fill((Tb, mB, max(p, 1)), self.bord_idx, self.bord_vals, bb_pg)
+        b = fill(
+            (Tb, mB),
+            self.b_idx,
+            self.b_vals,
+            [(k, s, pi, gi) for k, (gi, s, pi) in self.b_pgroups.items()],
+        )
+        c = fill(
+            (Tb, nB),
+            self.c_idx,
+            self.c_vals,
+            [(k, s, pi, gi) for (ib, k, gi, s, pi) in self.c_pgroups if not ib],
+        )
+        cb = fill(
+            (max(p, 1),),
+            self.cb_idx,
+            self.cb_vals,
+            [(k, s, pi, gi) for (ib, k, gi, s, pi) in self.c_pgroups if ib],
+        )
+        c0 = jnp.asarray(prog.c0_val, dtype)
+        for k, (scale, pidx) in prog.c0_pgroups.items():
+            c0 = c0 + jnp.sum(
+                jnp.asarray(scale, dtype) * jnp.ravel(params[k]).astype(dtype)[pidx]
+            )
+        return BandedLP(
+            Ad=Ad,
+            As=As,
+            Bb=Bb,
+            b=b,
+            c=c,
+            cb=cb,
+            l=jnp.asarray(self.l_t, dtype),
+            u=jnp.asarray(self.u_t, dtype),
+            lb=jnp.asarray(self.l_b, dtype),
+            ub=jnp.asarray(self.u_b, dtype),
+            c0=c0,
+        )
+
+
+def extract_time_structure(
+    prog: CompiledLP, T: int, block_hours: int = 24
+) -> TimeStructure:
+    """Detect the time-banded structure of a lowered LP and build the
+    scatter metadata. Columns of (T, ...)-shaped variables go to their time
+    block; scalar/non-time variables become border columns. Every row must
+    touch at most two adjacent column blocks (raises otherwise)."""
+    L = block_hours
+    if T % L:
+        raise ValueError(f"T={T} must be a multiple of block_hours={L}")
+    Tb = T // L
+    n_keep = len(prog._keep_cols)
+    N, M = prog.N, prog.M
+    Mi = prog.n_slack
+    Me = M - Mi
+
+    # ---- column blocks -------------------------------------------------
+    col_tb = np.full(N, -2, dtype=np.int64)  # -1 = border
+    for name, vm in prog._vars.items():
+        full_cols = np.arange(vm.start, vm.start + vm.size)
+        red = np.searchsorted(prog._keep_cols, full_cols)
+        ok = red < n_keep
+        ok[ok] = prog._keep_cols[red[ok]] == full_cols[ok]
+        offs = np.arange(vm.size)
+        if vm.shape and vm.shape[0] == T:
+            per_t = vm.size // T
+            tb = (offs // per_t) // L
+        else:
+            tb = np.full(vm.size, -1)
+        col_tb[red[ok]] = tb[ok]
+
+    # ---- row blocks ----------------------------------------------------
+    pat_r = [np.asarray(prog.A_rows)]
+    pat_c = [np.asarray(prog.A_cols)]
+    for rows, cols, _, _ in prog.A_pgroups.values():
+        pat_r.append(np.asarray(rows))
+        pat_c.append(np.asarray(cols))
+    pr = np.concatenate(pat_r)
+    pc = np.concatenate(pat_c)
+    keep = (pc < n_keep) & (col_tb[pc] >= 0)  # non-slack, non-border
+    row_min = np.full(M, np.iinfo(np.int64).max)
+    row_max = np.full(M, -1)
+    np.minimum.at(row_min, pr[keep], col_tb[pc[keep]])
+    np.maximum.at(row_max, pr[keep], col_tb[pc[keep]])
+    untouched = row_max < 0
+    row_min[untouched] = 0
+    row_max[untouched] = 0
+    if np.any(row_max - row_min > 1):
+        bad = np.where(row_max - row_min > 1)[0][:5]
+        raise ValueError(
+            f"rows {bad} span non-adjacent time blocks "
+            f"(e.g. {row_min[bad[0]]}..{row_max[bad[0]]}) — not time-banded "
+            "at this block size"
+        )
+    row_tb = row_max
+    # slack columns inherit their row's block
+    col_tb[n_keep + np.arange(Mi)] = row_tb[Me + np.arange(Mi)]
+    assert not np.any(col_tb == -2), "unassigned columns"
+
+    # ---- positions & padding ------------------------------------------
+    def positions(blocks, num):
+        """Per-element position within its block + per-block counts."""
+        pos = np.zeros(len(blocks), dtype=np.int64)
+        counts = np.zeros(num, dtype=np.int64)
+        order = np.argsort(blocks, kind="stable")
+        sorted_b = blocks[order]
+        starts = np.searchsorted(sorted_b, np.arange(num))
+        ends = np.searchsorted(sorted_b, np.arange(num), side="right")
+        counts = ends - starts
+        within = np.arange(len(blocks)) - starts[sorted_b]
+        pos[order] = within
+        return pos, counts
+
+    row_pos, row_counts = positions(row_tb, Tb)
+    mB = int(row_counts.max())
+    tcols = np.where(col_tb >= 0)[0]
+    bcols = np.where(col_tb == -1)[0]
+    tpos, col_counts = positions(col_tb[tcols], Tb)
+    col_pos_in_block = np.zeros(N, dtype=np.int64)
+    col_pos_in_block[tcols] = tpos
+    nB = int(col_counts.max())
+    p = len(bcols)
+    bpos = np.zeros(N, dtype=np.int64)
+    bpos[bcols] = np.arange(p)
+
+    # flat position of each reduced column in the solver vector
+    col_pos = np.zeros(N, dtype=np.int64)
+    col_pos[tcols] = col_tb[tcols] * nB + col_pos_in_block[tcols]
+    col_pos[bcols] = Tb * nB + bpos[bcols]
+    row_pos_flat = row_tb * mB + row_pos
+
+    # ---- A scatter targets --------------------------------------------
+    def a_targets(rows, cols):
+        """(dest_code, flat_idx): 0=diag, 1=sub, 2=border."""
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        tb_r = row_tb[rows]
+        i = row_pos[rows]
+        dest = np.full(len(rows), -1, dtype=np.int64)
+        flat = np.zeros(len(rows), dtype=np.int64)
+        isb = col_tb[cols] == -1
+        dest[isb] = 2
+        flat[isb] = (tb_r[isb] * mB + i[isb]) * max(p, 1) + bpos[cols[isb]]
+        isd = ~isb & (col_tb[cols] == tb_r)
+        dest[isd] = 0
+        flat[isd] = (tb_r[isd] * mB + i[isd]) * nB + col_pos_in_block[cols[isd]]
+        iss = ~isb & (col_tb[cols] == tb_r - 1)
+        dest[iss] = 1
+        flat[iss] = (tb_r[iss] * mB + i[iss]) * nB + col_pos_in_block[cols[iss]]
+        if np.any(dest < 0):
+            raise ValueError("A entry below the sub-diagonal block band")
+        return dest, flat
+
+    dest, flat = a_targets(prog.A_rows, prog.A_cols)
+    vals = np.asarray(prog.A_vals)
+    diag_idx, diag_vals = flat[dest == 0], vals[dest == 0]
+    sub_idx, sub_vals = flat[dest == 1], vals[dest == 1]
+    bord_idx, bord_vals = flat[dest == 2], vals[dest == 2]
+
+    a_pgroups = []
+    for k, (rows, cols, scale, pidx) in prog.A_pgroups.items():
+        d, f = a_targets(rows, cols)
+        scale = np.asarray(scale)
+        pidx = np.asarray(pidx)
+        for code, name in [(0, "diag"), (1, "sub"), (2, "bord")]:
+            m = d == code
+            if m.any():
+                a_pgroups.append((name, k, f[m], scale[m], pidx[m]))
+
+    # ---- b / c targets -------------------------------------------------
+    b_idx = row_pos_flat[np.asarray(prog.b_rows)]
+    b_vals = np.asarray(prog.b_vals)
+    b_pgroups = {
+        k: (row_pos_flat[np.asarray(rows)], np.asarray(scale), np.asarray(pidx))
+        for k, (rows, scale, pidx) in prog.b_pgroups.items()
+    }
+
+    cc = np.asarray(prog.c_cols)
+    cv = np.asarray(prog.c_vals)
+    cisb = col_tb[cc] == -1
+    c_idx = col_tb[cc[~cisb]] * nB + col_pos_in_block[cc[~cisb]]
+    c_vals = cv[~cisb]
+    cb_idx = bpos[cc[cisb]]
+    cb_vals = cv[cisb]
+    c_pgroups = []
+    for k, (cols, scale, pidx) in prog.c_pgroups.items():
+        cols = np.asarray(cols)
+        scale = np.asarray(scale)
+        pidx = np.asarray(pidx)
+        isb = col_tb[cols] == -1
+        if (~isb).any():
+            c_pgroups.append(
+                (
+                    False,
+                    k,
+                    col_tb[cols[~isb]] * nB + col_pos_in_block[cols[~isb]],
+                    scale[~isb],
+                    pidx[~isb],
+                )
+            )
+        if isb.any():
+            c_pgroups.append((True, k, bpos[cols[isb]], scale[isb], pidx[isb]))
+
+    # ---- bounds (pad columns get the inert box [0, 1]) -----------------
+    l_t = np.zeros((Tb, nB))
+    u_t = np.ones((Tb, nB))
+    l_t[col_tb[tcols], col_pos_in_block[tcols]] = prog.lb[tcols]
+    u_t[col_tb[tcols], col_pos_in_block[tcols]] = prog.ub[tcols]
+    l_b = prog.lb[bcols]
+    u_b = prog.ub[bcols]
+    if p == 0:
+        # synthetic inert border column keeps block shapes uniform
+        p = 1
+        l_b = np.zeros(1)
+        u_b = np.ones(1)
+
+    pad_rows = np.arange(mB)[None, :] >= row_counts[:, None]
+
+    return TimeStructure(
+        prog=prog,
+        T=T,
+        block_hours=L,
+        Tb=Tb,
+        mB=mB,
+        nB=nB,
+        p=p,
+        diag_idx=diag_idx,
+        diag_vals=diag_vals,
+        sub_idx=sub_idx,
+        sub_vals=sub_vals,
+        bord_idx=bord_idx,
+        bord_vals=bord_vals,
+        a_pgroups=a_pgroups,
+        b_idx=b_idx,
+        b_vals=b_vals,
+        b_pgroups=b_pgroups,
+        c_idx=c_idx,
+        c_vals=c_vals,
+        cb_idx=cb_idx,
+        cb_vals=cb_vals,
+        c_pgroups=c_pgroups,
+        l_t=l_t,
+        u_t=u_t,
+        l_b=l_b,
+        u_b=u_b,
+        col_pos=col_pos,
+        row_pos_flat=row_pos_flat,
+        pad_rows=pad_rows,
+    )
+
+
+# ----------------------------------------------------------------------
+# Block-tridiagonal Cholesky (scan) + Woodbury border
+# ----------------------------------------------------------------------
+def _block_chol(Ds, Es):
+    """Factor the block-tridiagonal SPD matrix with diagonal blocks `Ds`
+    and sub-diagonal blocks `Es` (Es[0] ignored) as L_blk L_blk^T where
+    L_blk has lower-triangular L_t on the diagonal and C_t on the
+    sub-diagonal: D_t = C_t C_t^T + L_t L_t^T, E_t = C_t L_{t-1}^T."""
+
+    def step(Lprev, DE):
+        D, E = DE
+        # C = E Lprev^{-T}
+        C = lax.linalg.triangular_solve(
+            Lprev, E, left_side=False, lower=True, transpose_a=True
+        )
+        Lt = jnp.linalg.cholesky(D - C @ C.T)
+        return Lt, (Lt, C)
+
+    L0 = jnp.linalg.cholesky(Ds[0])
+    _, (Ls, Cs) = lax.scan(step, L0, (Ds[1:], Es[1:]))
+    Ls = jnp.concatenate([L0[None], Ls])
+    Cs = jnp.concatenate([jnp.zeros_like(Es[:1]), Cs])
+    return Ls, Cs
+
+
+def _bt_solve(Ls, Cs, r):
+    """Solve the factored block-tridiagonal system for RHS r of shape
+    (Tb, mB) or (Tb, mB, k)."""
+    vec = r.ndim == 2
+    if vec:
+        r = r[..., None]
+    mB, k = r.shape[1], r.shape[2]
+
+    def fwd(vprev, LCr):
+        L, C, rt = LCr
+        v = lax.linalg.triangular_solve(
+            L, rt - C @ vprev, left_side=True, lower=True
+        )
+        return v, v
+
+    _, vs = lax.scan(fwd, jnp.zeros((mB, k), r.dtype), (Ls, Cs, r))
+
+    Cnext = jnp.concatenate([Cs[1:], jnp.zeros_like(Cs[:1])])
+
+    def bwd(xnext, LCv):
+        L, Cn, v = LCv
+        x = lax.linalg.triangular_solve(
+            L, v - Cn.T @ xnext, left_side=True, lower=True, transpose_a=True
+        )
+        return x, x
+
+    _, xs = lax.scan(
+        bwd, jnp.zeros((mB, k), r.dtype), (Ls, Cnext, vs), reverse=True
+    )
+    return xs[..., 0] if vec else xs
+
+
+def _shift_down(a):
+    """a[t] -> a[t-1] content: out[0]=0, out[t]=a[t-1]."""
+    return jnp.concatenate([jnp.zeros_like(a[:1]), a[:-1]])
+
+
+def _banded_ops(Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None):
+    """(matvec, rmatvec, make_kkt_solver) for `ipm._solve_scaled`, operating
+    on flat vectors laid out [Tb*nB time-cols | p border-cols] (x-space) and
+    [Tb*mB] (y-space).
+
+    `pad_rows` (Tb, mB) marks all-zero padding rows: they get a UNIT
+    diagonal in the normal equations instead of just reg_d. Their RHS is
+    exactly zero, so dy stays 0 either way — but a reg_d-only diagonal puts
+    a 1/reg_d eigenvalue into K^-1 that amplifies f32 rounding noise
+    catastrophically over long factorization chains (the year-scale f32
+    failure mode: breakdown by iteration 5 at Tb=365)."""
+    dtype = Ad.dtype
+    nt = Tb * nB
+    diag_shift = jnp.asarray(reg_d, dtype) * jnp.eye(mB, dtype=dtype)
+    if pad_rows is not None:
+        diag_shift = diag_shift + jax.vmap(jnp.diag)(
+            jnp.asarray(pad_rows, dtype)
+        )
+
+    def matvec(x):
+        xt = x[:nt].reshape(Tb, nB)
+        xb = x[nt:]
+        y = jnp.einsum("tij,tj->ti", Ad, xt)
+        y = y + jnp.einsum("tij,tj->ti", As, _shift_down(xt))
+        y = y + Bb @ xb
+        return y.reshape(-1)
+
+    def rmatvec(y):
+        yt = y.reshape(Tb, mB)
+        xt = jnp.einsum("tij,ti->tj", Ad, yt)
+        sub = jnp.einsum("tij,ti->tj", As, yt)  # contributes to cols t-1
+        xt = xt + jnp.concatenate([sub[1:], jnp.zeros_like(sub[:1])])
+        xb = jnp.einsum("tip,ti->p", Bb, yt)
+        return jnp.concatenate([xt.reshape(-1), xb])
+
+    def make_kkt_solver(d):
+        w = 1.0 / d
+        wt = w[:nt].reshape(Tb, nB)
+        wb = w[nt:]
+        db = d[nt:]
+        wprev = _shift_down(wt)
+        Ds = jnp.einsum("tij,tj,tkj->tik", Ad, wt, Ad)
+        Ds = Ds + jnp.einsum("tij,tj,tkj->tik", As, wprev, As)
+        Ds = Ds + diag_shift
+        Es = jnp.einsum("tij,tj,tkj->tik", As, wprev, _shift_down(Ad))
+        Ls, Cs = _block_chol(Ds, Es)
+
+        def base(rt):
+            return _bt_solve(Ls, Cs, rt)
+
+        if p:
+            # Woodbury: K = Kb + B diag(wb) B^T
+            Z = base(Bb)  # (Tb, mB, p) = Kb^{-1} B
+            S = jnp.diag(db) + jnp.einsum("tip,tiq->pq", Bb, Z)
+            S_cf = jax.scipy.linalg.cho_factor(S)
+
+            def solve(r):
+                rt = r.reshape(Tb, mB)
+                Fr = base(rt)
+                t = jax.scipy.linalg.cho_solve(
+                    S_cf, jnp.einsum("tip,ti->p", Bb, Fr)
+                )
+                return (Fr - jnp.einsum("tip,p->ti", Z, t)).reshape(-1)
+
+        else:
+
+            def solve(r):
+                return base(r.reshape(Tb, mB)).reshape(-1)
+
+        return solve
+
+    return matvec, rmatvec, make_kkt_solver
+
+
+# ----------------------------------------------------------------------
+def _ruiz_banded(Ad, As, Bb, iters: int = 8):
+    """Ruiz equilibration over the banded representation: returns row
+    scaling r (Tb, mB), time-col scaling ct (Tb, nB), border-col cb (p,)."""
+    Tb, mB, nB = Ad.shape
+    p = Bb.shape[2]
+    dtype = Ad.dtype
+    r = jnp.ones((Tb, mB), dtype)
+    ct = jnp.ones((Tb, nB), dtype)
+    cbv = jnp.ones((p,), dtype)
+
+    def sc(x):
+        return 1.0 / jnp.sqrt(jnp.where(x > 0, x, 1.0))
+
+    def body(_, st):
+        r, ct, cbv = st
+
+        def scaled():
+            ad = Ad * r[:, :, None] * ct[:, None, :]
+            as_ = As * r[:, :, None] * _shift_down(ct)[:, None, :]
+            bb = Bb * r[:, :, None] * cbv[None, None, :]
+            return ad, as_, bb
+
+        ad, as_, bb = scaled()
+        rmax = jnp.maximum(
+            jnp.max(jnp.abs(ad), axis=2),
+            jnp.maximum(
+                jnp.max(jnp.abs(as_), axis=2), jnp.max(jnp.abs(bb), axis=2)
+            ),
+        )
+        r = r * sc(rmax)
+        ad, as_, bb = scaled()
+        # col t gets entries from Ad[t] and As[t+1]
+        sub_next = jnp.concatenate(
+            [jnp.max(jnp.abs(as_), axis=1)[1:], jnp.zeros((1, nB), dtype)]
+        )
+        cmax = jnp.maximum(jnp.max(jnp.abs(ad), axis=1), sub_next)
+        ct = ct * sc(cmax)
+        cbv = cbv * sc(jnp.max(jnp.abs(bb), axis=(0, 1)))
+        return (r, ct, cbv)
+
+    r, ct, cbv = lax.fori_loop(0, iters, body, (r, ct, cbv))
+    return r, ct, cbv
+
+
+@partial(
+    jax.jit, static_argnames=("meta", "max_iter", "refine_steps", "d_cap")
+)
+def _solve_banded_jit(meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap):
+    Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0 = blp
+    dtype = Ad.dtype
+    Tb, mB, nB = Ad.shape
+    p = meta.p
+    nt = Tb * nB
+
+    with jax.default_matmul_precision("highest"):
+        r, ct, cbv = _ruiz_banded(Ad, As, Bb)
+        Ad_s = Ad * r[:, :, None] * ct[:, None, :]
+        As_s = As * r[:, :, None] * _shift_down(ct)[:, None, :]
+        Bb_s = Bb * r[:, :, None] * cbv[None, None, :]
+        b_s = (b * r).reshape(-1)
+        c_flat = jnp.concatenate([(c * ct).reshape(-1), cb * cbv])
+        cs_all = jnp.concatenate([ct.reshape(-1), cbv])
+        l_flat = jnp.concatenate([lt.reshape(-1), lb]) / cs_all
+        u_flat = jnp.concatenate([ut.reshape(-1), ub]) / cs_all
+
+        sig_c = jnp.maximum(1.0, jnp.max(jnp.abs(c_flat)))
+        sig_b = jnp.maximum(
+            1.0,
+            jnp.maximum(
+                jnp.max(jnp.abs(b_s), initial=0.0),
+                jnp.max(jnp.where(jnp.isfinite(l_flat), jnp.abs(l_flat), 0.0)),
+            ),
+        )
+
+        ops = _banded_ops(
+            Ad_s, As_s, Bb_s, Tb, mB, nB, p, reg_d, pad_rows=meta.pad_rows
+        )
+        sol = _solve_scaled(
+            LPData(
+                A=None,
+                b=b_s / sig_b,
+                c=c_flat / sig_c,
+                l=l_flat / sig_b,
+                u=u_flat / sig_b,
+                c0=jnp.zeros_like(c0),
+            ),
+            tol,
+            max_iter,
+            reg_p,
+            reg_d,
+            refine_steps,
+            None,
+            ops=ops,
+            d_cap=d_cap,
+        )
+        # unscale and map back to the CompiledLP's reduced column order
+        x_flat = sol.x * cs_all * sig_b
+        x_red = x_flat[jnp.asarray(meta.col_pos)]
+        y = (sol.y.reshape(Tb, mB) * r * sig_c).reshape(-1)
+        zl = (sol.zl / cs_all * sig_c)[jnp.asarray(meta.col_pos)]
+        zu = (sol.zu / cs_all * sig_c)[jnp.asarray(meta.col_pos)]
+        obj = (
+            jnp.sum(c * (x_flat[:nt]).reshape(Tb, nB))
+            + cb @ x_flat[nt:]
+            + c0
+        )
+    return IPMSolution(
+        x=x_red,
+        y=y,
+        zl=zl,
+        zu=zu,
+        obj=obj,
+        converged=sol.converged,
+        iterations=sol.iterations,
+        res_primal=sol.res_primal,
+        res_dual=sol.res_dual,
+        gap=sol.gap,
+    )
+
+
+def solve_lp_banded(
+    meta: TimeStructure,
+    blp: BandedLP,
+    tol: float = 1e-8,
+    max_iter: int = 60,
+    reg_p: float = None,
+    reg_d: float = None,
+    refine_steps: int = 2,
+    d_cap: float = None,
+) -> IPMSolution:
+    """Solve a time-banded LP by the block-tridiagonal IPM. Returns a
+    solution with ``x`` in the CompiledLP's reduced column order, so
+    `prog.extract` / `prog.eval_expr` work unchanged; ``y`` is in the
+    banded row order (use ``meta.row_pos_flat`` to map duals).
+
+    In f32 the barrier weights are capped (`d_cap`, default 1e12): the
+    uncapped z/x spread breaks long block-factorization chains on some LMP
+    draws, and the capped solve converges across seeds at Tb=73 with gaps
+    ~1e-5 (a tighter 1e10 cap biases the solution visibly; 1e12 does not)."""
+    dtype = blp.Ad.dtype
+    if reg_p is None:
+        reg_p = 1e-13 if dtype == jnp.float64 else 1e-8
+    if reg_d is None:
+        reg_d = 1e-12 if dtype == jnp.float64 else 1e-7
+    if d_cap is None and dtype != jnp.float64:
+        d_cap = 1e12
+    return _solve_banded_jit(
+        meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap
+    )
+
+
+def solve_horizon(
+    prog: CompiledLP,
+    params: Dict[str, jnp.ndarray],
+    T: int,
+    block_hours: int = 24,
+    dtype=None,
+    **solver_kw,
+) -> IPMSolution:
+    """One-call front-end: extract structure, instantiate, solve."""
+    meta = extract_time_structure(prog, T, block_hours)
+    blp = meta.instantiate(params, dtype=dtype)
+    return solve_lp_banded(meta, blp, **solver_kw)

@@ -21,6 +21,16 @@ DEFAULT_ELECTRICITY_TO_MOL = 0.00275984
 H2_MOLS_PER_KG = 500.0  # `load_parameters.py:26`
 
 
+def h2_value_per_kwh(
+    h2_price_per_kg: float,
+    electricity_to_mol: float = DEFAULT_ELECTRICITY_TO_MOL,
+) -> float:
+    """$ of hydrogen produced per kWh routed to the PEM — the marginal value
+    that sets the opportunity cost of selling electricity instead (used by
+    tracking and bidding to value PEM consumption consistently)."""
+    return h2_price_per_kg * 3600.0 * electricity_to_mol / H2_MOLS_PER_KG
+
+
 class PEMElectrolyzer(Unit):
     def __init__(
         self,

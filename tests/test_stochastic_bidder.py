@@ -1,0 +1,226 @@
+"""Scenario-stochastic Bidder / SelfScheduler (market/stochastic.py).
+
+Reference behavior: IDAES grid_integration's stochastic `Bidder` and
+`SelfScheduler` driven by a `Backcaster`
+(`test_multiperiod_wind_battery_doubleloop.py:113+`). The headline test:
+stochastic DA bids beat a miscalibrated parametrized bidder on realized
+profit in the in-framework market (VERDICT round-1 item 5)."""
+import numpy as np
+import pytest
+
+from dispatches_tpu.market.bidder import PEMParametrizedBidder
+from dispatches_tpu.market.coordinator import DoubleLoopCoordinator
+from dispatches_tpu.market.double_loop import (
+    MultiPeriodWindBattery,
+    MultiPeriodWindPEM,
+)
+from dispatches_tpu.market.forecaster import Backcaster
+from dispatches_tpu.market.model_data import RenewableGeneratorModelData
+from dispatches_tpu.market.simulator import SimpleMarket, StaticGenerator
+from dispatches_tpu.market.stochastic import SelfScheduler, StochasticBidder
+from dispatches_tpu.market.tracker import Tracker
+from dispatches_tpu.units.pem import h2_value_per_kwh
+
+WIND_MW = 50.0
+PEM_MW = 20.0
+H2_PRICE = 1.25  # => marginal H2 value ~ $22.9/MWh, straddled by DAILY_LMP
+# $/MWh marginal value of routing electricity to the PEM
+H2_MARGINAL = h2_value_per_kwh(H2_PRICE) * 1e3
+
+
+def _model_data():
+    return RenewableGeneratorModelData(
+        gen_name="309_WIND_1",
+        bus="Carter",
+        p_min=0.0,
+        p_max=WIND_MW,
+        generator_type="renewable",
+    )
+
+
+def _wind_pem(cfs):
+    return MultiPeriodWindPEM(
+        model_data=_model_data(),
+        wind_capacity_factors=cfs,
+        wind_pmax_mw=WIND_MW,
+        pem_pmax_mw=PEM_MW,
+        h2_price_per_kg=H2_PRICE,
+    )
+
+
+DAILY_CF = np.array([0.7, 0.8, 0.9, 0.8, 0.6, 0.5, 0.4, 0.5] * 3)
+# three price regimes: below, straddling, above the PEM marginal value
+DAILY_LMP = np.array([5.0, 10.0, 15.0, 28.0, 40.0, 35.0, 12.0, 8.0] * 3)
+
+
+def _scripted_backcaster(n_days=3, jitter=0.0, seed=0):
+    rng = np.random.default_rng(seed)
+    hist = np.concatenate(
+        [DAILY_LMP + rng.normal(0, jitter, 24) for _ in range(n_days)]
+    )
+    return Backcaster(hist, n_historical_days=n_days)
+
+
+def test_stochastic_bidder_curve_reflects_h2_marginal_value():
+    """With LMP scenarios straddling the PEM's marginal H2 value, the bid
+    curve should withhold the PEM tranche in scenarios priced below
+    ~H2_MARGINAL and offer the full wind in those above it — the economics
+    show up on the quantity side of the scenario bid curve."""
+    cfs = np.tile(DAILY_CF, 10)
+    # three level-scaled scenario days: 0.7x / 1.0x / 1.3x the daily pattern
+    hist = np.concatenate([DAILY_LMP * f for f in (0.7, 1.0, 1.3)])
+    bidder = StochasticBidder(
+        _wind_pem(cfs),
+        day_ahead_horizon=24,
+        real_time_horizon=4,
+        forecaster=Backcaster(hist, n_historical_days=3),
+        n_scenario=3,
+    )
+    bids = bidder.compute_day_ahead_bids(0)
+    gen = "309_WIND_1"
+    for t, hour_bids in bids.items():
+        curve = hour_bids[gen]["p_cost"]
+        # cumulative curve: power and cost nondecreasing (valid Egret curve)
+        pws = [p for p, _ in curve]
+        assert all(b >= a - 1e-9 for a, b in zip(pws, pws[1:]))
+        wind_mw = DAILY_CF[t % 24] * WIND_MW
+        # never offers more than the forecast wind
+        assert hour_bids[gen]["p_max"] <= wind_mw + 1e-6
+
+    # hour 3 (scenarios 19.6 / 28 / 36.4 straddle H2_MARGINAL=24.8, wind
+    # 40 MW): the 19.6 scenario withholds the 20 MW PEM band, the upper
+    # scenarios offer full wind -> breakpoint at 20 MW, top at 40 MW
+    pws = [p for p, _ in bids[3][gen]["p_cost"]]
+    assert bids[3][gen]["p_max"] == pytest.approx(40.0, rel=1e-2)
+    assert any(abs(p - 20.0) < 0.5 for p in pws), pws
+    # hour 0 (scenarios 3.5 / 5 / 6.5, all below marginal): PEM band (20 MW)
+    # withheld in every scenario — only wind minus PEM is offered
+    assert bids[0][gen]["p_max"] == pytest.approx(
+        DAILY_CF[0] * WIND_MW - PEM_MW, rel=1e-2
+    )
+
+
+def test_self_scheduler_non_anticipative():
+    cfs = np.tile(DAILY_CF, 10)
+    sched = SelfScheduler(
+        _wind_pem(cfs),
+        day_ahead_horizon=24,
+        real_time_horizon=4,
+        forecaster=_scripted_backcaster(jitter=5.0),
+        n_scenario=3,
+    )
+    T = 24
+    scen = sched._scenarios_for(0, 0, T)
+    pows, _ = sched._solve_bidding(T, scen, cfs[:T])
+    # one schedule across scenarios
+    for s in range(1, pows.shape[0]):
+        np.testing.assert_allclose(pows[s], pows[0], atol=1e-4)
+    bids = sched.compute_day_ahead_bids(0)
+    gen = "309_WIND_1"
+    assert bids[0][gen]["p_max"] == pytest.approx(float(pows[0][0]), abs=1e-3)
+
+
+def test_wind_battery_stochastic_smoke():
+    """Battery variant: state params honored, monotone sorted powers."""
+    cfs = np.tile(DAILY_CF, 10)
+    mo = MultiPeriodWindBattery(
+        model_data=_model_data(),
+        wind_capacity_factors=cfs,
+        wind_pmax_mw=WIND_MW,
+        battery_pmax_mw=10.0,
+        battery_energy_capacity_mwh=40.0,
+    )
+    mo.state["soc0"] = 5e3  # 5 MWh in kWh
+    bidder = StochasticBidder(
+        mo,
+        day_ahead_horizon=12,
+        real_time_horizon=4,
+        forecaster=_scripted_backcaster(jitter=4.0),
+        n_scenario=3,
+    )
+    T = 12
+    scen = bidder._scenarios_for(0, 0, T)
+    pows, sol = bidder._solve_bidding(T, scen, cfs[:T])
+    assert bool(np.asarray(sol.converged))
+    # sorted-by-price powers are monotone per hour
+    for t in range(T):
+        order = np.argsort(scen[:, t], kind="stable")
+        ps = pows[order, t]
+        assert np.all(np.diff(ps) >= -1e-4), (t, ps)
+
+
+def _run_market(bidder_factory, n_days=3):
+    """Run the double loop in SimpleMarket; returns realized profit
+    (electricity revenue + H2 value)."""
+    cfs = np.tile(DAILY_CF, 400)
+    mo_bid = _wind_pem(cfs)
+    mo_track = _wind_pem(cfs)
+    bidder = bidder_factory(mo_bid)
+    tracker = Tracker(mo_track, tracking_horizon=4, n_tracking_hour=1)
+    coord = DoubleLoopCoordinator(bidder, tracker)
+    # fleet whose merit order reproduces DAILY_LMP as demand varies
+    fleet = [
+        StaticGenerator("g5", 100.0, 5.0),
+        StaticGenerator("g8", 60.0, 8.0),
+        StaticGenerator("g10", 60.0, 10.0),
+        StaticGenerator("g12", 60.0, 12.0),
+        StaticGenerator("g15", 80.0, 15.0),
+        StaticGenerator("g28", 80.0, 28.0),
+        StaticGenerator("g35", 60.0, 35.0),
+        StaticGenerator("g40", 120.0, 40.0),
+    ]
+    # demand profile hitting each marginal block in the DAILY_LMP pattern
+    price_to_demand = {5.0: 80, 8.0: 140, 10.0: 200, 12.0: 260, 15.0: 330,
+                      28.0: 430, 35.0: 500, 40.0: 580}
+    demand = np.array([price_to_demand[p] for p in DAILY_LMP])
+    market = SimpleMarket(demand_mw=demand, fleet=fleet, day_ahead_horizon=24)
+    results = market.simulate(coord, n_days=n_days, tracking_horizon=4)
+
+    elec_rev = sum(r["Revenue [$]"] for r in results)
+    h2_kg = sum(
+        row["H2 Production [kg/hr]"]
+        for row in mo_track.result_list
+        if row["Horizon [hr]"] == 0
+    )
+    return elec_rev + h2_kg * H2_PRICE
+
+
+def test_stochastic_beats_miscalibrated_parametrized_bidder():
+    """The reference's parametrized bidder needs a hand-tuned marginal cost;
+    set it badly (bid PEM tranche at $5/MWh when H2 is worth ~$22.7/MWh) and
+    the stochastic bidder, which derives the threshold from its scenario
+    program, must realize more profit in the same market."""
+
+    def parametrized(mo):
+        from dispatches_tpu.market.forecaster import PerfectForecaster
+
+        cf = np.tile(DAILY_CF, 400)
+        fc = PerfectForecaster(
+            {
+                "309_WIND_1-DACF": cf,
+                "309_WIND_1-RTCF": cf,
+                "Carter-DALMP": np.tile(DAILY_LMP, 400),
+                "Carter-RTLMP": np.tile(DAILY_LMP, 400),
+            }
+        )
+        return PEMParametrizedBidder(
+            mo,
+            day_ahead_horizon=24,
+            real_time_horizon=4,
+            forecaster=fc,
+            pem_marginal_cost=5.0,  # miscalibrated: true value ~22.9
+            pem_mw=PEM_MW,
+        )
+
+    def stochastic(mo):
+        return StochasticBidder(
+            mo,
+            day_ahead_horizon=24,
+            real_time_horizon=4,
+            forecaster=_scripted_backcaster(jitter=1.0),
+            n_scenario=3,
+        )
+
+    profit_param = _run_market(parametrized)
+    profit_stoch = _run_market(stochastic)
+    assert profit_stoch > profit_param * 1.02, (profit_stoch, profit_param)
