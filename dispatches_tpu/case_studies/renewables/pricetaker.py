@@ -347,9 +347,12 @@ def wind_battery_pem_tank_turb_optimize(
 
 
 def _results(prog, sol, p, design: HybridDesign):
+    from ...runtime.telemetry import batch_stats
+
     out = {
         "converged": bool(np.asarray(sol.converged)),
         "iterations": int(np.asarray(sol.iterations)),
+        "solver_stats": batch_stats(sol),
         "NPV": float(prog.eval_expr("NPV", sol.x, p)),
         "annual_revenue": float(prog.eval_expr("annual_revenue", sol.x, p)),
         "annual_rev_E": float(prog.eval_expr("annual_rev_E", sol.x, p)),

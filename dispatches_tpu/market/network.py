@@ -50,6 +50,10 @@ class ThermalUnit:
     # piecewise marginal costs: segment widths (MW) + $/MWh, lowest first
     seg_mw: np.ndarray
     seg_cost: np.ndarray
+    # $/hr while committed: the p_min block at the average heat rate
+    # (RTS HR_avg_0) — constant given commitment, so it prices the
+    # commitment decision (UC) but not the dispatch (DC-OPF)
+    base_cost_hr: float = 0.0
 
     @property
     def avg_cost(self) -> float:
@@ -146,6 +150,7 @@ def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
                 start_cost=float(r.get("Non Fuel Start Cost $", 0) or 0),
                 seg_mw=np.asarray(seg_mw),
                 seg_cost=np.asarray(seg_cost),
+                base_cost_hr=p_min * hrs[0] * MMBTU_PER_MWH * fuel,
             )
         )
 
@@ -373,6 +378,13 @@ class UnitCommitment:
                     break
                 commit[t, gi] = 1.0
                 cap += g.thermal[gi].p_max
+        return self.smooth(commit)
+
+    def smooth(self, commit: np.ndarray) -> np.ndarray:
+        """Repair a 0/1 schedule to satisfy min-up/min-down (shared with
+        the optimizing RUC's rounding step)."""
+        g = self.grid
+        T = commit.shape[0]
         # min-up smoothing: extend each ON run to its unit's min_up
         for gi, u in enumerate(g.thermal):
             on = commit[:, gi].astype(bool)
@@ -383,7 +395,7 @@ class UnitCommitment:
                     on = commit[:, gi].astype(bool)
                 t += 1
         # min-down: a unit that turns off stays off min_down hours; if the
-        # heuristic wants it back sooner, keep it ON through the gap instead
+        # schedule wants it back sooner, keep it ON through the gap instead
         for gi, u in enumerate(g.thermal):
             on = commit[:, gi].astype(bool)
             t = 1
@@ -401,6 +413,201 @@ class UnitCommitment:
         return commit
 
 
+def uc_program(grid: GridData, T: int = 24):
+    """Copper-plate unit-commitment LP (relaxed): continuous commitment
+    u[t,g] in [0,1] with startup costs, min-up/min-down windows, piecewise
+    dispatch segments, renewable caps, reserve requirement and priced load
+    shedding. Params: ``load_total`` (T,), ``ren_total`` (T,).
+
+    The same tensors feed three consumers: the device LP relaxation
+    (`OptimizingUnitCommitment`), the exact HiGHS MILP reference
+    (`solve_uc_milp`, commitment columns marked integral), and the
+    rounding-repair cost evaluation. The reference solves this as a CBC
+    MILP inside Prescient (`prescient_options.py:32-38`)."""
+    g = grid
+    G = len(g.thermal)
+    m = Model("ruc")
+    load = m.param("load_total", T)
+    ren = m.param("ren_total", T)
+
+    u = m.var("commit", (T, G), ub=1.0)
+    s = m.var("startup", (T, G), ub=1.0)
+    shed = m.var("shed", T)
+    ren_p = m.var("ren_used", T)
+    m.add_le(ren_p - ren)
+
+    init_on = np.zeros(G)
+    if g.initial_on:
+        for gi, unit in enumerate(g.thermal):
+            init_on[gi] = 1.0 if g.initial_on.get(unit.name, 0) > 0 else 0.0
+
+    total_inj = shed + ren_p  # (T,) rows
+    cap_committed = None  # for the reserve requirement
+    cost = 1000.0 * shed.sum()
+    for gi, unit in enumerate(g.thermal):
+        ug = u[:, gi]
+        sg = s[:, gi]
+        on0 = float(init_on[gi])
+        # startup definition: s[t] >= u[t] - u[t-1]
+        m.add_ge(sg[0:1] - ug[0:1] + on0, 0.0)
+        if T > 1:
+            m.add_ge(sg[1:] - ug[1:] + ug[:-1], 0.0)
+        # min-up windows: u[t+dt] >= u[t] - u[t-1] for dt in [1, min_up)
+        for dt in range(1, min(int(unit.min_up), T)):
+            m.add_ge(ug[dt : dt + 1] - ug[0:1] + on0, 0.0)  # t = 0
+            if T - dt - 1 > 0:
+                m.add_ge(ug[1 + dt :] - ug[1 : T - dt] + ug[: T - dt - 1], 0.0)
+        # min-down windows: 1 - u[t+dt] >= u[t-1] - u[t]
+        for dt in range(1, min(int(unit.min_down), T)):
+            m.add_ge(1.0 - ug[dt : dt + 1] - on0 + ug[0:1], 0.0)  # t = 0
+            if T - dt - 1 > 0:
+                m.add_ge(
+                    1.0 - ug[1 + dt :] - ug[: T - dt - 1] + ug[1 : T - dt], 0.0
+                )
+        gen_g = None
+        for si, (wmw, c) in enumerate(zip(unit.seg_mw, unit.seg_cost)):
+            v = m.var(f"ruc.{unit.name}.seg{si}", T)
+            m.add_le(v - float(wmw) * ug)
+            cost = cost + float(c) * v.sum()
+            gen_g = v if gen_g is None else gen_g + v
+        base = unit.p_min * ug
+        total_inj = total_inj + base + (gen_g if gen_g is not None else 0.0)
+        cap_term = unit.p_max * ug
+        cap_committed = cap_term if cap_committed is None else cap_committed + cap_term
+        cost = cost + unit.start_cost * sg.sum() + unit.base_cost_hr * ug.sum()
+
+    # demand balance and reserve-capacity requirement
+    m.add_eq(total_inj - load.view())
+    m.add_ge(cap_committed + ren - load.view() - g.reserve_mw, 0.0)
+    m.expression("uc_cost", cost)
+    m.minimize(cost * 1e-3)
+    prog = m.build()
+    prog.uc_T = T
+    prog.uc_G = G
+    return prog
+
+
+def solve_uc_milp(prog, params):
+    """Exact UC by HiGHS MILP on the SAME LP tensors: commitment and
+    startup columns marked integral. Host-side reference for validating
+    the device relax-and-repair path (reference: Prescient's CBC RUC)."""
+    from scipy.optimize import LinearConstraint, milp
+
+    import jax.numpy as jnp
+
+    lp = prog.instantiate({k: jnp.asarray(v) for k, v in params.items()})
+    A = np.asarray(lp.A, np.float64)
+    b = np.asarray(lp.b, np.float64)
+    c = np.asarray(lp.c, np.float64)
+    l = np.asarray(lp.l, np.float64)
+    ub = np.asarray(lp.u, np.float64)
+    integrality = np.zeros(len(c))
+    cols = prog.col_index("commit")
+    integrality[cols] = 1
+    from scipy.optimize import Bounds
+
+    res = milp(
+        c,
+        constraints=[LinearConstraint(A, b, b)],
+        bounds=Bounds(l, ub),
+        integrality=integrality,
+    )
+    if res.status != 0:
+        raise RuntimeError(f"HiGHS MILP failed: {res.status} {res.message}")
+    res.obj_with_offset = res.fun + float(lp.c0)
+    return res
+
+
+class OptimizingUnitCommitment:
+    """Optimizing RUC: device LP relaxation -> threshold rounding ->
+    min-up/min-down repair -> vmapped candidate cost evaluation, picking
+    the cheapest feasible schedule. Matches the exact MILP commitment cost
+    to within 1% on the bundled 5-bus day (test_network.py) — replacing
+    round 1's pure merit-order heuristic."""
+
+    def __init__(self, grid: GridData, T: int = 24,
+                 thresholds=(0.02, 0.1, 0.25, 0.5, 0.75, 0.9)):
+        self.grid = grid
+        self.T = T
+        self.thresholds = thresholds
+        self.prog = uc_program(grid, T)
+        self._heuristic = UnitCommitment(grid)
+
+    # -- pieces ---------------------------------------------------------
+    def _relax(self, loads_total, ren_total):
+        import jax.numpy as jnp
+
+        p = {
+            "load_total": jnp.asarray(loads_total),
+            "ren_total": jnp.asarray(ren_total),
+        }
+        sol = solve_lp(self.prog.instantiate(p), tol=1e-8, max_iter=60)
+        u = np.asarray(self.prog.extract("commit", sol.x))
+        return np.clip(u, 0.0, 1.0)
+
+    def _repair(self, commit):
+        """Min-up/min-down smoothing (the heuristic's repair pass)."""
+        return self._heuristic.smooth(commit.copy())
+
+    def _evaluate(self, candidates, loads_total, ren_total):
+        """Total cost of each candidate schedule (startup + base + committed
+        economic dispatch) via one batched device solve: candidates are a
+        vmap axis of the same UC LP with the commitment columns driven to
+        the candidate by a dominant linear penalty (an interior point
+        cannot take pinned lb==ub columns; a penalty vertex can). The true
+        cost is read from the 'uc_cost' expression at the solution; a
+        candidate whose commitment deviates (the penalty lost, i.e. the
+        schedule is infeasible) is reported non-converged."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.program import LPData
+
+        C = candidates.shape[0]
+        params = {
+            "load_total": jnp.asarray(loads_total),
+            "ren_total": jnp.asarray(ren_total),
+        }
+        lp = self.prog.instantiate(params)
+        cols = jnp.asarray(self.prog.col_index("commit"))
+        penalty = 1e3  # objective is in k$; 1e3 = $1M per unit-hour deviation
+
+        def one(cand_flat):
+            # min penalty*|u - cand| as a linear term: -penalty*u for
+            # cand=1, +penalty*u for cand=0
+            c2 = lp.c.at[cols].add(penalty * (1.0 - 2.0 * cand_flat))
+            sol = solve_lp(
+                LPData(A=lp.A, b=lp.b, c=c2, l=lp.l, u=lp.u, c0=lp.c0),
+                tol=1e-7,
+                max_iter=60,
+            )
+            dev = jnp.max(jnp.abs(sol.x[cols] - cand_flat))
+            cost = self.prog.eval_expr("uc_cost", sol.x, params)
+            return cost, sol.converged & (dev < 1e-4)
+
+        costs, ok = jax.vmap(one)(jnp.asarray(candidates.reshape(C, -1)))
+        return np.asarray(costs), np.asarray(ok)
+
+    def commit(self, loads_total: np.ndarray, ren_total: np.ndarray):
+        import warnings
+
+        heuristic = self._heuristic.commit(loads_total, ren_total)
+        u_rel = self._relax(loads_total, ren_total)
+        cands = [heuristic]
+        for tau in self.thresholds:
+            cands.append(self._repair((u_rel >= tau).astype(float)))
+        cands = np.unique(np.stack(cands), axis=0)
+        costs, conv = self._evaluate(cands, loads_total, ren_total)
+        costs = np.where(conv, costs, np.inf)
+        if not np.isfinite(costs).any():
+            warnings.warn(
+                "optimizing RUC: no candidate schedule evaluated cleanly; "
+                "falling back to the merit-order heuristic"
+            )
+            return heuristic
+        return cands[int(np.argmin(costs))]
+
+
 # ------------------------------------------------- production-cost simulator
 class ProductionCostSimulator:
     """Day-ahead RUC + hourly SCED over the network — the Prescient analogue
@@ -414,9 +621,14 @@ class ProductionCostSimulator:
         grid: GridData,
         participant_segments: int = 0,
         participant_bus: Optional[int] = None,
+        uc: str = "optimizing",  # "optimizing" | "heuristic"
     ):
         self.grid = grid
-        self.uc = UnitCommitment(grid)
+        self.uc = (
+            OptimizingUnitCommitment(grid)
+            if uc == "optimizing"
+            else UnitCommitment(grid)
+        )
         self.prog = dcopf_program(grid, participant_segments, participant_bus)
         self.participant_segments = participant_segments
         self.results: List[dict] = []

@@ -242,7 +242,7 @@ class StochasticBidder(ParametrizedBidder):
         return full_bids
 
     # ------------------------------------------------------------------
-    def _scenarios_for(self, date, hour, horizon):
+    def _scenarios_for(self, date, hour, horizon, market: str):
         f = self.forecaster
         if hasattr(f, "forecast_scenarios"):
             # anchor the scenarios to the bidding hour-of-day so RT bids at
@@ -252,11 +252,13 @@ class StochasticBidder(ParametrizedBidder):
                 f.forecast_scenarios(horizon, hour_of_day=int(hour) % 24)
             )
         else:
-            scen = np.asarray(
-                f.forecast_day_ahead_prices(
-                    date, hour, getattr(self.bidding_model_object.model_data, "bus", "bus"), horizon
-                )
-            )[None, :]
+            bus = getattr(self.bidding_model_object.model_data, "bus", "bus")
+            fn = (
+                f.forecast_day_ahead_prices
+                if market == "Day-ahead"
+                else f.forecast_real_time_prices
+            )
+            scen = np.asarray(fn(date, hour, bus, horizon))[None, :]
         S = self.n_scenario
         if scen.shape[0] >= S:
             scen = scen[-S:]
@@ -265,32 +267,25 @@ class StochasticBidder(ParametrizedBidder):
             scen = np.tile(scen, (reps, 1))[:S]
         return scen
 
-    def compute_day_ahead_bids(self, date, hour=0):
-        T = self.day_ahead_horizon
-        scen = self._scenarios_for(date, hour, T)
+    def _compute_bids(self, date, hour, T, market):
+        scen = self._scenarios_for(date, hour, T, market)
         cf = self.bidding_model_object.get_params(date, hour, T)["wind_cf"]
         pows, _ = self._solve_bidding(T, scen, cf)
         if self.self_schedule:
             bids = self._self_schedule_bids(pows, hour)
         else:
             bids = self._curves_from_solution(scen, pows, hour)
-        self._record_bids(bids, date, hour, Market="Day-ahead")
+        self._record_bids(bids, date, hour, Market=market)
         return bids
+
+    def compute_day_ahead_bids(self, date, hour=0):
+        return self._compute_bids(date, hour, self.day_ahead_horizon, "Day-ahead")
 
     def compute_real_time_bids(
         self, date, hour, realized_day_ahead_prices=None,
         realized_day_ahead_dispatches=None,
     ):
-        T = self.real_time_horizon
-        scen = self._scenarios_for(date, hour, T)
-        cf = self.bidding_model_object.get_params(date, hour, T)["wind_cf"]
-        pows, _ = self._solve_bidding(T, scen, cf)
-        if self.self_schedule:
-            bids = self._self_schedule_bids(pows, hour)
-        else:
-            bids = self._curves_from_solution(scen, pows, hour)
-        self._record_bids(bids, date, hour, Market="Real-time")
-        return bids
+        return self._compute_bids(date, hour, self.real_time_horizon, "Real-time")
 
 
 class SelfScheduler(StochasticBidder):

@@ -90,6 +90,42 @@ class SolveTelemetry:
         return "\n".join(lines)
 
 
+def batch_stats(sol) -> dict:
+    """Self-diagnosing statistics for a batched IPM/NLP solution: converged
+    fraction, iteration histogram, and residual quantiles. The fields bench
+    regressions need at a glance (round 1 shipped a bench whose metric said
+    converged=0.000 — these stats make that impossible to miss)."""
+    conv = np.atleast_1d(np.asarray(sol.converged))
+    iters = np.atleast_1d(np.asarray(sol.iterations))
+    # integer bin edges so rounded labels can never collide (a colliding
+    # label would silently drop a bin from the dict)
+    lo, hi = int(iters.min()), int(iters.max())
+    step = max(1, int(np.ceil((hi - lo + 1) / 8)))
+    edges = np.arange(lo, hi + step + 1, step)
+    counts, edges = np.histogram(iters, bins=edges)
+    stats = {
+        "batch": int(conv.size),
+        "converged_frac": float(conv.mean()),
+        "iterations": {
+            "min": lo,
+            "median": float(np.median(iters)),
+            "max": hi,
+            "hist": {
+                f"{int(edges[i])}-{int(edges[i + 1])}": int(counts[i])
+                for i in range(len(counts))
+            },
+        },
+    }
+    for field in ("res_primal", "res_dual", "gap"):
+        v = np.atleast_1d(np.asarray(getattr(sol, field)))
+        stats[field] = {
+            "median": float(np.median(v)),
+            "p90": float(np.quantile(v, 0.9)),
+            "max": float(v.max()),
+        }
+    return stats
+
+
 def check_finite(tree, name: str = "value"):
     """Raise FloatingPointError if any leaf holds NaN/Inf. Host-side guard
     for solve outputs and checkpoint payloads."""

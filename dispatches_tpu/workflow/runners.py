@@ -81,7 +81,15 @@ def run_pricetaker(
                 [h2, rec["NPV"], rec["annual_revenue"], rec["pem_kw"], rec["batt_kw"]],
             )
         if verbose:
-            print(f"[{i}] h2=${h2}/kg: NPV ${rec['NPV']:.3e} pem {rec['pem_kw']:.0f} kW")
+            st = res.get("solver_stats", {})
+            it = st.get("iterations", {})
+            print(
+                f"[{i}] h2=${h2}/kg: NPV ${rec['NPV']:.3e} "
+                f"pem {rec['pem_kw']:.0f} kW | converged "
+                f"{st.get('converged_frac', float('nan')):.3f}, "
+                f"iters {it.get('median', '?')}, "
+                f"gap {st.get('gap', {}).get('max', float('nan')):.1e}"
+            )
     return out
 
 

@@ -110,7 +110,7 @@ def test_self_scheduler_non_anticipative():
         n_scenario=3,
     )
     T = 24
-    scen = sched._scenarios_for(0, 0, T)
+    scen = sched._scenarios_for(0, 0, T, "Day-ahead")
     pows, _ = sched._solve_bidding(T, scen, cfs[:T])
     # one schedule across scenarios
     for s in range(1, pows.shape[0]):
@@ -139,7 +139,7 @@ def test_wind_battery_stochastic_smoke():
         n_scenario=3,
     )
     T = 12
-    scen = bidder._scenarios_for(0, 0, T)
+    scen = bidder._scenarios_for(0, 0, T, "Day-ahead")
     pows, sol = bidder._solve_bidding(T, scen, cfs[:T])
     assert bool(np.asarray(sol.converged))
     # sorted-by-price powers are monotone per hour
