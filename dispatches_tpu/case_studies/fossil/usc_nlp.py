@@ -1,0 +1,317 @@
+"""Ultra-supercritical steam-cycle NLP — the physics tier behind the map.
+
+A faithful reduced re-build of the reference's 1,352-line USC flowsheet
+(`fossil_case/ultra_supercritical_plant/ultra_supercritical_powerplant.py:
+71-1352`) on IF97 steam properties + the framework's Newton solver: the full
+11-stage turbine train with two reheats, the nine closed feedwater heaters
+with UA-LMTD condensing heat transfer and cascading drains, the deaerator,
+condensate/booster/boiler-feed pumps, and the boiler-feed-pump turbine
+(BFPT) power balance. All fixed data (stage pressure ratios/efficiencies,
+reheater pressure drops, FWH areas/OHTC, pump data) are the reference's
+`set_model_input` values (`:714-805`).
+
+The unknowns the reference's IPOPT solve determines — nine FWH extraction
+fractions, nine feedwater outlet enthalpies, and the BFPT extraction — are
+here a 19-equation square system solved by `solvers/nlp.solve_square`
+(autodiff Jacobian, damped Newton). The same system supports the three
+golden modes of `tests/test_usc_powerplant.py`:
+
+  design   : P=31.126 MPa, flow=17,854 mol/s -> power 436.466 MW
+  power    : power fixed 300 MW, flow free  -> flow 12,474.473 mol/s
+  pressure : flow fixed, P=27 MPa           -> power 446.15 MW, duty 940.4
+
+The dispatch-layer performance map (`usc_plant.py`) is re-derived from
+these solves (`derive_performance_map`), replacing round 1's map-anchored
+constants with physics.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...properties import steam as st
+from ...solvers.nlp import solve_square
+
+MW_H2O = 0.01801528  # kg/mol
+
+# ---- reference data (`set_model_input`, `:714-805`) ----------------------
+MAIN_FLOW_MOL = 17854.0
+MAIN_STEAM_P = 31125980.0
+MAIN_STEAM_T = 866.15
+RATIO_P = np.array(
+    [0.388, 0.774, 0.498, 0.609, 0.523, 0.495, 0.514, 0.389, 0.572, 0.476, 0.204]
+)
+TURB_EFF = np.array(
+    [0.94, 0.94, 0.94, 0.94, 0.88, 0.88, 0.78, 0.78, 0.78, 0.78, 0.78]
+)
+RH_DELTAP = {3: 742845.0, 5: 210952.0}  # reheater before stages 3 and 5
+DEA_SPLIT = 0.017885  # deaerator extraction (splitter 5, fixed, `:771`)
+COND_PUMP_DP = 2313881.0
+BOOSTER_DP = 5715067.0
+BFP_P_RATIO = 1.1231  # bfp outlet = main steam pressure * ratio (`:774`)
+PUMP_EFF = 0.8
+CONDENSER_P = 6896.0  # Pa (`:945`)
+FWH_AREA = {1: 250.0, 2: 195.0, 3: 164.0, 4: 208.0, 5: 152.0,
+            6: 207.0, 7: 202.0, 8: 715.0, 9: 175.0}
+FWH_U = 3000.0  # W/m^2/K
+# shell-side (drain) outlet pressure: 1.1 * ratio * (P_ext - rh_diff) — the
+# condensate is throttled toward the next-lower extraction pressure
+# (`fwh_s1pdrop_constraint`, `:292-357`); drains leave SATURATED at that
+# pressure (`:254-263`)
+FWH_DRAIN_RATIO = {1: 0.204, 2: 0.476, 3: 0.572, 4: 0.389, 5: 0.514,
+                   6: 0.523, 7: 0.609, 8: 0.498, 9: 0.774}
+FWH_DRAIN_DIFF = {6: 210952.0, 8: 742845.0}
+FWH_TUBE_DP_RATIO = 0.96  # 4% feedwater-side drop (`fwh_s2pdrop_constraint`)
+
+# extraction topology (arcs `:424-711`): splitter k -> consumer
+#   1->fwh9  2->fwh8  3->fwh7  4->fwh6  5->deaerator
+#   6(out2)->fwh5  6(out3)->bfpt  7->fwh4  8->fwh3  9->fwh2  10->fwh1
+FWH_OF_SPLIT = {1: 9, 2: 8, 3: 7, 4: 6, 6: 5, 7: 4, 8: 3, 9: 2, 10: 1}
+SPLIT_OF_FWH = {v: k for k, v in FWH_OF_SPLIT.items()}
+
+# reference initialization estimates (`:857-866`) — Newton starting point
+INIT_FRACS = np.array(
+    [0.073444, 0.140752, 0.032816, 0.012425, 0.081155,
+     0.036058, 0.026517, 0.029888, 0.003007]
+)  # fwh9, fwh8, fwh7, fwh6, fwh5, fwh4, fwh3, fwh2, fwh1 (splitter order)
+INIT_BFPT = 0.091274
+
+
+class CycleResult(NamedTuple):
+    power_mw: jnp.ndarray  # -sum(turbine work) / 1e6, bfpt excluded
+    heat_duty_mw: jnp.ndarray  # boiler + both reheaters
+    boiler_flow_mol: jnp.ndarray
+    fracs: jnp.ndarray  # (9,) FWH extraction fractions, splitter order
+    bfpt_frac: jnp.ndarray
+    h_fw: jnp.ndarray  # (9,) feedwater outlet enthalpies [J/kg], fwh1..fwh9
+    residual: jnp.ndarray
+
+
+def _lmtd_underwood(dt1, dt2):
+    """Underwood approximation (the reference's delta-T callback,
+    `:180`): ((dt1^(1/3) + dt2^(1/3)) / 2)^3, smooth-clipped positive."""
+    a = jnp.maximum(dt1, 1e-2) ** (1.0 / 3.0)
+    b = jnp.maximum(dt2, 1e-2) ** (1.0 / 3.0)
+    return (0.5 * (a + b)) ** 3
+
+
+def _cycle_residuals(x, params):
+    """The 19-equation square system. x = [fracs(9), bfpt_frac, h_fw(9)]
+    with h_fw scaled by 1e-6 (J/kg -> MJ/kg) for Newton conditioning."""
+    P_main = params["P_main"]
+    flow_mol = params["flow_mol"]
+    mflow = flow_mol * MW_H2O
+
+    fracs = x[:9]  # splitter order: s1(fwh9) s2 s3 s4 s6_2(fwh5) s7 s8 s9 s10
+    f_bfpt = x[9]
+    h_fw = x[10:19] * 1e6  # fwh1..fwh9 tube-outlet enthalpies [J/kg]
+
+    # ---- turbine train forward pass -----------------------------------
+    split_of_stage = {1: fracs[0], 2: fracs[1], 3: fracs[2], 4: fracs[3],
+                      5: DEA_SPLIT, 6: fracs[4] + f_bfpt, 7: fracs[5],
+                      8: fracs[6], 9: fracs[7], 10: fracs[8]}
+    P_in = P_main
+    h_in = st.props_vapor(P_in, MAIN_STEAM_T).h
+    T_in = MAIN_STEAM_T
+    flow = mflow
+    W = 0.0
+    Q_rh = 0.0
+    ext = {}  # splitter k -> (mass flow, h, P, T) of extraction
+    h_boiler_out = h_in
+    for k in range(1, 12):
+        if k in RH_DELTAP:
+            P2 = P_in - RH_DELTAP[k]
+            h2 = st.props_vapor(P2, MAIN_STEAM_T).h
+            Q_rh = Q_rh + flow * (h2 - h_in)
+            P_in, h_in, T_in = P2, h2, MAIN_STEAM_T
+        P_out = RATIO_P[k - 1] * P_in
+        ex = st.turbine_expansion(P_in, T_in, P_out, TURB_EFF[k - 1])
+        W = W + flow * (h_in - ex.h_out)
+        h_in, T_in, P_in = ex.h_out, ex.T_out, P_out
+        if k in split_of_stage:
+            ext[k] = (flow, h_in, P_out, T_in)
+            flow = flow * (1.0 - split_of_stage[k])
+
+    # ---- feedwater-side pressures (4% tube drop per FWH) ---------------
+    P_dea = ext[5][2]  # deaerator at extraction-5 pressure (Helm min-mix)
+    r = FWH_TUBE_DP_RATIO
+    P_lp0 = CONDENSER_P + COND_PUMP_DP
+    P_ip0 = P_dea + BOOSTER_DP
+    P_hp0 = MAIN_STEAM_P * BFP_P_RATIO  # bfp outlet held at DESIGN pressure
+    # tube-side inlet/outlet pressures per FWH (fwh1..fwh9)
+    P_fw_in = jnp.array(
+        [P_lp0, P_lp0 * r, P_lp0 * r**2, P_lp0 * r**3, P_lp0 * r**4,
+         P_ip0, P_ip0 * r, P_hp0, P_hp0 * r]
+    )
+    P_fw_out = P_fw_in * r  # fwh9 outlet = boiler inlet (32.2 MPa, `:844`)
+
+    # ---- mass bookkeeping ---------------------------------------------
+    e = {k: ext[k][0] * split_of_stage[k] for k in ext}  # total per splitter
+    e_fwh = {FWH_OF_SPLIT[k]: e[k] for k in FWH_OF_SPLIT}
+    # splitter 6 feeds BOTH fwh5 (outlet_2) and the bfpt (outlet_3)
+    e_fwh[5] = ext[6][0] * fracs[4]
+    e_bfpt = ext[6][0] * f_bfpt
+    e_dea = e[5]
+    # condensate (fwh1-5 tube flow) = everything that reaches the condenser
+    cond_flow = mflow - (e_fwh[9] + e_fwh[8] + e_fwh[7] + e_fwh[6] + e_dea)
+    tube_flow = jnp.array([cond_flow] * 5 + [mflow] * 4)  # fwh1..fwh9
+
+    # ---- drain states: saturated liquid at the throttled shell-outlet
+    # pressure 1.1 * ratio * (P_ext - rh_diff) ---------------------------
+    P_drain = {
+        i: 1.1
+        * FWH_DRAIN_RATIO[i]
+        * (ext[SPLIT_OF_FWH[i]][2] - FWH_DRAIN_DIFF.get(i, 0.0))
+        for i in range(1, 10)
+    }
+    hf = {i: st.sat_liquid(P_drain[i]).h for i in range(1, 10)}
+    T_drain = {i: st.sat_temperature(P_drain[i]) for i in range(1, 10)}
+
+    # drain cascades: HP group 9->8->7->6->deaerator, LP group 5->4->3->2->1
+    drain_hp = {9: e_fwh[9]}
+    for i in (8, 7, 6):
+        drain_hp[i] = drain_hp[i + 1] + e_fwh[i]
+    drain_lp = {5: e_fwh[5]}
+    for i in (4, 3, 2, 1):
+        drain_lp[i] = drain_lp[i + 1] + e_fwh[i]
+
+    # ---- pumps and the feedwater chain ---------------------------------
+    h_cond = st.sat_liquid(CONDENSER_P).h
+    T_cond = st.sat_temperature(CONDENSER_P)
+    w_cond_pump = cond_flow * st.pump_work(CONDENSER_P, P_lp0, T_cond, PUMP_EFF)
+    h0 = h_cond + st.pump_work(CONDENSER_P, P_lp0, T_cond, PUMP_EFF)
+
+    # deaerator: feed (fwh5 out) + steam (e_dea) + fwh6 drain -> outlet
+    h_dea_out = (
+        cond_flow * h_fw[4] + e_dea * ext[5][1] + drain_hp[6] * hf[6]
+    ) / mflow
+    T_dea = st.temperature_ph_liquid(P_dea, h_dea_out)
+    w_booster = mflow * st.pump_work(P_dea, P_ip0, T_dea, PUMP_EFF)
+    h_booster_out = h_dea_out + st.pump_work(P_dea, P_ip0, T_dea, PUMP_EFF)
+    T_fw7 = st.temperature_ph_liquid(P_fw_out[6], h_fw[6])
+    w_bfp = mflow * st.pump_work(P_fw_out[6], P_hp0, T_fw7, PUMP_EFF)
+    h_bfp_out = h_fw[6] + st.pump_work(P_fw_out[6], P_hp0, T_fw7, PUMP_EFF)
+
+    h_in_fw = [h0, h_fw[0], h_fw[1], h_fw[2], h_fw[3],  # fwh1..5
+               h_booster_out, h_fw[5],  # fwh6, fwh7
+               h_bfp_out, h_fw[7]]  # fwh8, fwh9
+
+    # ---- FWH residuals: energy balance + UA-LMTD ----------------------
+    res = []
+    scale_q = 1e-7
+    for i in range(1, 10):
+        k = SPLIT_OF_FWH[i]
+        steam_flow, h_steam, P_sh, T_steam = ext[k]
+        e_i = e_fwh[i]
+        # drain entering this FWH's shell (mixed with the extraction in the
+        # fwh_mixer at the extraction pressure) from the next-higher FWH
+        if i in (8, 7, 6):
+            dr_in, h_dr = drain_hp[i + 1], hf[i + 1]
+        elif i in (4, 3, 2, 1):
+            dr_in, h_dr = drain_lp[i + 1], hf[i + 1]
+        else:
+            dr_in, h_dr = 0.0, 0.0
+        shell_flow = e_i + dr_in
+        h_shell_in = (e_i * h_steam + dr_in * h_dr) / jnp.maximum(shell_flow, 1e-9)
+        T_shell_in = st.temperature_ph(P_sh, h_shell_in)
+        q_shell = shell_flow * (h_shell_in - hf[i])
+        q_tube = tube_flow[i - 1] * (h_fw[i - 1] - h_in_fw[i - 1])
+        res.append(scale_q * (q_shell - q_tube))
+        # UA-LMTD: hot in = (mixed) shell inlet T, hot out = saturated
+        # drain T at the throttled shell-outlet pressure; Underwood
+        # callback as in the reference (`:180`)
+        T_fw_out = st.temperature_ph_liquid(P_fw_out[i - 1], h_fw[i - 1])
+        T_fw_in = st.temperature_ph_liquid(P_fw_in[i - 1], h_in_fw[i - 1])
+        lmtd = _lmtd_underwood(T_shell_in - T_fw_out, T_drain[i] - T_fw_in)
+        res.append(scale_q * (FWH_U * FWH_AREA[i] * lmtd - q_tube))
+
+    # ---- BFPT drives ALL pumps (`constraint_bfp_power`, `:372-377`) ---
+    bx = st.turbine_expansion(ext[6][2], ext[6][3], CONDENSER_P, PUMP_EFF)
+    w_bfpt = e_bfpt * bx.work
+    res.append(scale_q * (w_bfpt - (w_bfp + w_booster + w_cond_pump)))
+
+    return jnp.stack([jnp.asarray(r) for r in res]), (W, Q_rh, h_fw, mflow, h_boiler_out)
+
+
+def _residual_fn(x, params):
+    return _cycle_residuals(x, params)[0]
+
+
+def solve_usc_cycle(
+    P_main: float = MAIN_STEAM_P,
+    flow_mol: float = MAIN_FLOW_MOL,
+    tol: float = 1e-9,
+    max_iter: int = 60,
+) -> CycleResult:
+    """Solve the USC cycle square system at given throttle (P, flow)."""
+    params = {
+        "P_main": jnp.asarray(P_main, jnp.result_type(float)),
+        "flow_mol": jnp.asarray(flow_mol, jnp.result_type(float)),
+    }
+    x0 = jnp.concatenate(
+        [
+            jnp.asarray(INIT_FRACS),
+            jnp.asarray([INIT_BFPT]),
+            # feedwater enthalpy ramp guess: condenser to near-boiler
+            jnp.linspace(0.2, 1.2, 9),
+        ]
+    ).astype(jnp.result_type(float))
+    sol = solve_square(_residual_fn, x0, params=params, tol=tol, max_iter=max_iter)
+    _, (W, Q_rh, h_fw, mflow, h_boiler_out) = _cycle_residuals(sol.x, params)
+    # boiler duty: feedwater (fwh9 out) to main steam, plus the reheats
+    q_boiler = mflow * (h_boiler_out - h_fw[8])
+    return CycleResult(
+        power_mw=W / 1e6,
+        heat_duty_mw=(q_boiler + Q_rh) / 1e6,
+        boiler_flow_mol=params["flow_mol"],
+        fracs=sol.x[:9],
+        bfpt_frac=sol.x[9],
+        h_fw=h_fw,
+        residual=sol.kkt_error,
+    )
+
+
+def solve_usc_for_power(
+    power_mw: float,
+    P_main: float = MAIN_STEAM_P,
+    tol: float = 1e-9,
+    max_iter: int = 60,
+):
+    """Fix plant power, free boiler flow (test_change_power mode): one
+    outer Newton on the monotone power(flow) map around the cycle solve."""
+    flow = MAIN_FLOW_MOL * power_mw / 436.5  # proportional start
+
+    def power_of(fl):
+        return float(np.asarray(solve_usc_cycle(P_main, fl, tol, max_iter).power_mw))
+
+    for _ in range(8):
+        p = power_of(flow)
+        dp = (power_of(flow * 1.001) - p) / (flow * 0.001)
+        step = (power_mw - p) / dp
+        flow = flow + step
+        if abs(step) < 1e-4 * flow:
+            break
+    return flow, solve_usc_cycle(P_main, flow, tol, max_iter)
+
+
+def derive_performance_map(points=(0.65, 0.8, 0.9, 1.0)):
+    """Re-derive the dispatch-layer map constants (usc_plant.py) from the
+    NLP: max power / max duty at design flow, and the linear duty(power)
+    relation across the operating range."""
+    flows = [MAIN_FLOW_MOL * f for f in points]
+    sols = [solve_usc_cycle(flow_mol=fl) for fl in flows]
+    powers = np.array([float(np.asarray(s.power_mw)) for s in sols])
+    duties = np.array([float(np.asarray(s.heat_duty_mw)) for s in sols])
+    slope, intercept = np.polyfit(powers, duties, 1)
+    return {
+        "max_power_mw": powers[-1],
+        "max_duty_mw": duties[-1],
+        "duty_slope": slope,
+        "duty_intercept": intercept,
+        "powers": powers,
+        "duties": duties,
+    }

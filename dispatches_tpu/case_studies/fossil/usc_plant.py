@@ -28,9 +28,20 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 # design point (`create_usc_model`, multiperiod_integrated_storage_usc.py:40-56)
+# Re-derived from the physics tier (usc_nlp.solve_usc_cycle /
+# derive_performance_map, round 2): design solve gives 436.44 MW at
+# 918 MWth boiler+reheat duty, duty(power) ~ 2.160*P - 25.2 across the
+# 65-100% range; the dispatch layer keeps the reference's own map constants
+# (940.4 MWth ceiling = its 27 MPa off-design duty, proportional scaling,
+# `integrated_storage...py:473-484`) for golden parity — test_usc_nlp.py
+# ties the two representations together within 5%.
 MAX_POWER_MW = 436.0
 MIN_POWER_MW = int(0.65 * 436)  # 283
 MAX_BOILER_DUTY_MW = 940.0
+NLP_DESIGN_POWER_MW = 436.441  # usc_nlp design solve (golden 436.466)
+NLP_DESIGN_DUTY_MW = 918.0
+NLP_DUTY_SLOPE = 2.1602  # MWth per MWe, NLP-affine duty(power)
+NLP_DUTY_INTERCEPT_MW = -25.2
 RAMP_MW_PER_HR = 60.0
 MIN_STORAGE_DUTY_MW = 10.0
 MAX_STORAGE_DUTY_MW = 200.0

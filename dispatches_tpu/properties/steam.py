@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -214,10 +215,13 @@ def temperature_ph_vapor(P, h_target, T_guess=None, iters: int = 25):
         if T_guess is not None
         else jnp.maximum(sat_temperature(P) + 10.0, 300.0)
     )
-    for _ in range(iters):
+
+    def body(_, T):
         pr = props_vapor(P, T)
-        T = jnp.clip(T - (pr.h - h_target) / pr.cp, 273.16, 2273.15)
-    return T
+        return jnp.clip(T - (pr.h - h_target) / pr.cp, 273.16, 2273.15)
+
+    T = jnp.broadcast_to(T, jnp.broadcast_shapes(T.shape, h_target.shape))
+    return jax.lax.fori_loop(0, iters, body, T)
 
 
 def temperature_ph_liquid(P, h_target, iters: int = 25):
@@ -227,10 +231,12 @@ def temperature_ph_liquid(P, h_target, iters: int = 25):
     T = jnp.broadcast_to(
         jnp.asarray(400.0, P.dtype), jnp.broadcast_shapes(P.shape, h_target.shape)
     )
-    for _ in range(iters):
+
+    def body(_, T):
         pr = props_liquid(P, T)
-        T = jnp.clip(T - (pr.h - h_target) / pr.cp, 273.16, 647.0)
-    return T
+        return jnp.clip(T - (pr.h - h_target) / pr.cp, 273.16, 647.0)
+
+    return jax.lax.fori_loop(0, iters, body, T)
 
 
 def temperature_ph_fn(P, iters: int = 25):
@@ -301,10 +307,12 @@ def temperature_ps_vapor(P, s_target, iters: int = 25):
     s_target = jnp.asarray(s_target, jnp.result_type(float))
     T = jnp.maximum(sat_temperature(P) + 10.0, 300.0)
     T = jnp.broadcast_to(T, jnp.broadcast_shapes(P.shape, s_target.shape))
-    for _ in range(iters):
+
+    def body(_, T):
         pr = props_vapor(P, T)
-        T = jnp.clip(T - (pr.s - s_target) * T / pr.cp, 273.16, 2273.15)
-    return T
+        return jnp.clip(T - (pr.s - s_target) * T / pr.cp, 273.16, 2273.15)
+
+    return jax.lax.fori_loop(0, iters, body, T)
 
 
 # ----------------------------------------------------- cycle building blocks
