@@ -50,8 +50,8 @@ class DoubleLoopCoordinator:
                 gen_dict[param] = value
 
     # -- market-host callbacks ------------------------------------------
-    def compute_day_ahead_bids(self, day: int):
-        return self.bidder.compute_day_ahead_bids(day, 0)
+    def compute_day_ahead_bids(self, day: int, hour: int = 0):
+        return self.bidder.compute_day_ahead_bids(day, hour)
 
     def compute_real_time_bids(self, day: int, hour: int, da_prices=None, da_dispatches=None):
         return self.bidder.compute_real_time_bids(day, hour, da_prices, da_dispatches)
@@ -62,18 +62,17 @@ class DoubleLoopCoordinator:
     # -- Prescient interop (optional dependency) -------------------------
     @property
     def prescient_plugin_module(self):
-        try:
-            from types import ModuleType
-        except ImportError:  # pragma: no cover
-            raise
-        try:
-            import prescient  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "Prescient is not installed in this environment; use "
-                "dispatches_tpu.market.simulator for the in-framework market "
-                "host, or install gridx-prescient for the full co-simulation."
-            ) from e
+        """A plugin module with `get_configuration`/`register_plugins`,
+        matching the surface Prescient's plugin loader consumes and the
+        reference's `coordinator.prescient_plugin_module`
+        (`dispatches/workflow/coordinator.py:42-44`).
+
+        Constructing and registering the module requires NO prescient
+        install: the callbacks duck-type against Egret-style model dicts
+        (`md.data['elements']['generator'][name]`), which is also what the
+        real Prescient hands to plugin callbacks. Only launching
+        `Prescient().simulate(...)` itself needs gridx-prescient."""
+        from types import ModuleType
 
         coordinator = self
 
@@ -83,14 +82,118 @@ class DoubleLoopCoordinator:
 
             @staticmethod
             def get_configuration(key):
-                from prescient.plugins import PluginRegistrationContext  # noqa: F401
-
                 return {}
 
             @staticmethod
             def register_plugins(context, options, plugin_config):
+                # mirror of the reference coordinator's registration set
+                # (`dispatches/workflow/coordinator.py:29-41`): static-param
+                # push before both market solves, DA bids before RUC, RT
+                # bids before SCED, tracking after operations.
                 context.register_before_ruc_solve_callback(
-                    lambda *a, **k: None
+                    coordinator._plugin_before_ruc_solve
+                )
+                context.register_before_operations_solve_callback(
+                    coordinator._plugin_before_operations_solve
+                )
+                context.register_after_operations_callback(
+                    coordinator._plugin_after_operations
                 )
 
         return PluginModule()
+
+    # -- plugin callbacks (Egret-dict duck-typed) ------------------------
+    def _participant_gen_dict(self, model) -> Optional[dict]:
+        gens = model.data["elements"]["generator"]
+        name = self.bidder.bidding_model_object.model_data.gen_name
+        return gens.get(name)
+
+    @staticmethod
+    def _apply_cost_curve(gen_dict: dict, bid: dict):
+        """Write one hour-bid's curve (`{"p_cost": [(mw, $)...]}`, the shape
+        ParametrizedBidder emits) into an Egret generator dict. p_max is the
+        caller's concern (scalar for SCED, time series for RUC)."""
+        gen_dict["p_cost"] = {
+            "data_type": "cost_curve",
+            "cost_curve_type": "piecewise",
+            "values": list(bid["p_cost"]),
+        }
+
+    @staticmethod
+    def _model_n_periods(model) -> Optional[int]:
+        """Time-period count of an Egret-shaped model, when discoverable."""
+        try:
+            keys = model.data["system"]["time_keys"]
+        except (AttributeError, KeyError, TypeError):
+            return None
+        return len(keys) if keys is not None else None
+
+    def _plugin_before_ruc_solve(self, options, simulator, ruc_instance, ruc_date, ruc_hour):
+        gen_dict = self._participant_gen_dict(ruc_instance)
+        if gen_dict is None:
+            return
+        self.update_static_params(gen_dict)
+        day = _date_to_day(ruc_date)
+        hour0 = int(ruc_hour or 0)
+        bids = self.compute_day_ahead_bids(day, hour0)  # {abs_hour: {gen: bid}}
+        name = self.bidder.bidding_model_object.model_data.gen_name
+        hours = sorted(bids)
+        # per-hour bid curves -> time-varying p_max series + first-hour curve
+        # (Egret cost curves are static per solve; Prescient re-enters here
+        # every RUC, so the curve tracks the forecast day by day)
+        self._apply_cost_curve(gen_dict, bids[hours[0]][name])
+        pmax_series = [bids[h][name]["p_max"] for h in hours]
+        # Egret wants one value per model time period (Prescient's default
+        # ruc_horizon is 48 h while bidders often carry 24): cycle the bid
+        # day to fill, trim if the bidder over-supplied
+        n_periods = self._model_n_periods(ruc_instance)
+        if n_periods is not None and len(pmax_series) != n_periods:
+            reps = -(-n_periods // len(pmax_series))  # ceil
+            pmax_series = (pmax_series * reps)[:n_periods]
+        gen_dict["p_max"] = {
+            "data_type": "time_series",
+            "values": pmax_series,
+        }
+
+    def _plugin_before_operations_solve(self, options, simulator, sced_instance):
+        gen_dict = self._participant_gen_dict(sced_instance)
+        if gen_dict is None:
+            return
+        self.update_static_params(gen_dict)
+        day, hour = _sim_day_hour(simulator)
+        bids = self.compute_real_time_bids(day, hour)  # {abs_hour: {gen: bid}}
+        name = self.bidder.bidding_model_object.model_data.gen_name
+        bid = bids[min(bids)][name]
+        self._apply_cost_curve(gen_dict, bid)
+        gen_dict["p_max"] = bid["p_max"]
+
+    def _plugin_after_operations(self, options, simulator, sced_instance, lmp_sced=None):
+        gen_dict = self._participant_gen_dict(sced_instance)
+        if gen_dict is None:
+            return
+        pg = gen_dict.get("pg", 0.0)
+        if isinstance(pg, dict):
+            dispatch = list(pg["values"])
+        else:
+            dispatch = [float(pg)]
+        day, hour = _sim_day_hour(simulator)
+        self.track_sced_dispatch(dispatch, day, hour)
+
+
+def _date_to_day(date) -> int:
+    from .tracker import _date_index
+
+    return _date_index(date)
+
+
+def _sim_day_hour(simulator):
+    """Current (day, hour) from a Prescient-shaped simulator
+    (`simulator.time_manager.current_time` with `.date`/`.hour`); plain
+    `(day, hour)` tuples pass through for the in-framework host."""
+    if isinstance(simulator, tuple):
+        return simulator
+    tm = getattr(simulator, "time_manager", None)
+    ct = getattr(tm, "current_time", None)
+    if ct is None:
+        return 0, 0
+    return _date_to_day(ct.date), int(ct.hour)

@@ -34,13 +34,29 @@ class Tracker:
         curtailment_cost: float = 0.1,  # $/MWh tie-break: prefer storing to spilling
         cycling_cost: float = 0.01,  # $/MWh on battery throughput: no charge/discharge loops
         solver_kw: Optional[dict] = None,
+        dtype=None,
     ):
         self.tracking_model_object = tracking_model_object
         self.tracking_horizon = tracking_horizon
         self.n_tracking_hour = n_tracking_hour
+        self.dtype = jnp.dtype(dtype) if dtype is not None else jnp.result_type(float)
         # tight default tolerance: the tie-break costs are ~1e-4 of the
-        # deviation penalty and must still be resolved to pick the vertex
-        self.solver_kw = {"tol": 1e-10, **(solver_kw or {})}
+        # deviation penalty and must still be resolved to pick the vertex.
+        # In f32 the tight target is unreachable (eps ~ 1e-7); use the
+        # tightest tolerance the dtype can actually certify.
+        default_tol = 1e-10 if self.dtype == jnp.float64 else 3e-6
+        self.solver_kw = {"tol": default_tol, **(solver_kw or {})}
+        # f32 rescaling: the objective is normalized by max|c| (~the
+        # deviation penalty), so a tie-break at 1e-4 of the penalty lands
+        # below the f32-achievable duality gap and the store-don't-spill
+        # vertex is not resolved. Compress the dynamic range instead of
+        # tightening the tolerance: a 10x smaller penalty (still >> all
+        # physical costs) and 100x larger tie-breaks (still 10x below the
+        # penalty) put every coefficient inside f32's resolvable window.
+        if self.dtype != jnp.float64:
+            tracking_penalty *= 0.1
+            curtailment_cost *= 100.0
+            cycling_cost *= 100.0
 
         T = tracking_horizon
         m, power_out_mw = tracking_model_object.build_program(T)
@@ -87,8 +103,8 @@ class Tracker:
         md = np.asarray(market_dispatch, dtype=float)
         disp[: len(md)] = md[:T]
         params["dispatch"] = disp
-        jparams = {k: jnp.asarray(v) for k, v in params.items()}
-        lp = self.program.instantiate(jparams)
+        jparams = {k: jnp.asarray(v, self.dtype) for k, v in params.items()}
+        lp = self.program.instantiate(jparams, dtype=self.dtype)
         sol = solve_lp(lp, **self.solver_kw)
         x = sol.x
         self._last_x, self._last_params = x, jparams

@@ -39,6 +39,8 @@ def kmeans(
     n, d = X.shape
     key = jax.random.PRNGKey(seed)
 
+    x2 = jnp.sum(X**2, 1)
+
     def init_pp(key):
         k1, key = jax.random.split(key)
         idx0 = jax.random.randint(k1, (), 0, n)
@@ -46,11 +48,15 @@ def kmeans(
 
         def pick(i, carry):
             centers, key = carry
+            # matmul-form distances: an (n, k) product, never the (n, k, d)
+            # broadcast (at sweep scale — millions of days — the broadcast
+            # form is tens of GB)
+            d2all = x2[:, None] - 2 * X @ centers.T + jnp.sum(centers**2, 1)[None, :]
             d2 = jnp.min(
-                jnp.sum((X[:, None, :] - centers[None, :, :]) ** 2, -1)
-                + jnp.where(jnp.arange(k)[None, :] >= i, jnp.inf, 0.0),
+                d2all + jnp.where(jnp.arange(k)[None, :] >= i, jnp.inf, 0.0),
                 axis=1,
             )
+            d2 = jnp.maximum(d2, 0.0)  # matmul form can go slightly negative
             key, kk = jax.random.split(key)
             probs = d2 / jnp.maximum(d2.sum(), 1e-30)
             idx = jax.random.choice(kk, n, p=probs)
@@ -117,9 +123,11 @@ class TimeSeriesClustering:
         flat = days[keep]
         return flat, zero_mask.sum(axis=1), full_mask.sum(axis=1)
 
-    def clustering_data(self, cf_series: np.ndarray, seed: int = 42) -> dict:
+    def clustering_data(
+        self, cf_series: np.ndarray, seed: int = 42, **kmeans_kw
+    ) -> dict:
         flat, zero_days, full_days = self.transform_data(np.asarray(cf_series))
-        res = kmeans(jnp.asarray(flat), self.num_clusters, seed=seed)
+        res = kmeans(jnp.asarray(flat), self.num_clusters, seed=seed, **kmeans_kw)
         self.result = {
             "centers": np.asarray(res.centers),
             "labels": np.asarray(res.labels),
@@ -150,5 +158,11 @@ class TimeSeriesClustering:
         return d
 
     def assign_labels(self, days: np.ndarray, centers: np.ndarray) -> np.ndarray:
-        d2 = ((days[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        # matmul form (never (n, k, d)): nearest-center assignment stays
+        # O(n*k) memory at sweep scale (millions of days)
+        d2 = (
+            (days**2).sum(1)[:, None]
+            - 2.0 * days @ centers.T
+            + (centers**2).sum(1)[None, :]
+        )
         return d2.argmin(axis=1)

@@ -56,6 +56,16 @@ class SimulationData:
     # -- readers for the reference's file formats ------------------------
     @staticmethod
     def _read_dispatch_csv(path: str, num_sims: Optional[int]):
+        # 10k-run sweep tables are ~600 MB of text; the native mmap'd
+        # parallel reader (csrc/dispatches_native.cpp) handles them in
+        # seconds. It requires a numeric first field (string run labels like
+        # "run_37" read as header rows there) — those fall back to pandas.
+        from ..runtime.native import native_available, read_csv_matrix
+
+        if native_available():
+            mat = read_csv_matrix(path, rows=(0, num_sims) if num_sims else None)
+            if mat.size and not np.isnan(mat[:, 0]).any():
+                return mat[:, 1:], mat[:, 0].astype(int)
         import pandas as pd
 
         df = pd.read_csv(path, nrows=num_sims)

@@ -40,7 +40,18 @@ class SurrogateMLP(nn.Module):
 def _r2(y_true, y_pred):
     ss_res = jnp.sum((y_true - y_pred) ** 2, axis=0)
     ss_tot = jnp.sum((y_true - jnp.mean(y_true, axis=0)) ** 2, axis=0)
-    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30)
+    # constant outputs (e.g. a cluster-frequency bin that never occurs in
+    # the sweep) have ss_tot ~ 0 and R2 is undefined; score them by the
+    # residual against the output's overall scale instead of its variance
+    scale = jnp.maximum(
+        jnp.sum(y_true**2, axis=0), jnp.ones_like(ss_tot) * y_true.shape[0] * 1e-12
+    )
+    degenerate = ss_tot < 1e-9 * scale
+    return jnp.where(
+        degenerate,
+        1.0 - ss_res / scale,
+        1.0 - ss_res / jnp.maximum(ss_tot, 1e-30),
+    )
 
 
 class TrainedSurrogate:
@@ -162,14 +173,17 @@ class TrainNNSurrogates:
         zero_mask = day_sums < 1e-8
         full_mask = (days > 1 - 1e-3).all(axis=2)
         n_days = days.shape[1]
-        for r in range(runs):
-            keep = ~(zero_mask[r] | full_mask[r])
-            freqs[r, 0] = zero_mask[r].sum() / n_days
-            freqs[r, k + 1] = full_mask[r].sum() / n_days
-            if keep.any():
-                lab = tsc.assign_labels(days[r][keep], centers)
-                for c in range(k):
-                    freqs[r, c + 1] = (lab == c).sum() / n_days
+        freqs[:, 0] = zero_mask.sum(axis=1) / n_days
+        freqs[:, k + 1] = full_mask.sum(axis=1) / n_days
+        # assign every kept day in one shot (a 10k-run sweep is ~3.6M days:
+        # one (N, k) matmul + a bincount, not a Python loop over runs)
+        keep = ~(zero_mask | full_mask)
+        keep_flat = keep.reshape(-1)
+        if keep_flat.any():
+            lab = tsc.assign_labels(days.reshape(-1, 24)[keep_flat], centers)
+            run_ids = np.repeat(np.arange(runs), n_days)[keep_flat]
+            counts = np.bincount(run_ids * k + lab, minlength=runs * k)
+            freqs[:, 1 : k + 1] = counts.reshape(runs, k) / n_days
         return freqs
 
     def train_NN_frequency(self, hidden=(100, 100), epochs=500, **kw):

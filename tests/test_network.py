@@ -227,3 +227,66 @@ class TestOptimizingUC:
                 t = t2
             assert all(r >= u.min_up for r in runs_on), (u.name, runs_on)
             assert all(r >= u.min_down for r in runs_off), (u.name, runs_off)
+
+
+class TestSCEDReserve:
+    """Spinning-reserve product in the SCED LP (Prescient parity: reserves
+    bind in both market stages, `prescient_options.py:23`)."""
+
+    def _one_hour(self, prog, req, commit=None, hour=12):
+        sim = ProductionCostSimulator(GRID)
+        loads = sim._bus_loads(GRID.da_load[hour])[None]
+        commit = np.ones((1, 4)) if commit is None else commit
+        return solve_hours(
+            prog, GRID, loads, GRID.da_renewables[hour][None], commit,
+            reserve_req=np.array([req]),
+        )
+
+    def test_reserve_held_and_headroom_respected(self):
+        prog = dcopf_program(GRID, reserve=True)
+        res = self._one_hour(prog, req=60.0)
+        assert res["converged"].all()
+        x = res["x"][0]
+        total_r = sum(
+            float(np.asarray(prog.extract(f"{u.name}.reserve", x)))
+            for u in GRID.thermal
+        )
+        rshort = float(np.asarray(prog.extract("reserve_shortfall", x)))
+        assert total_r + rshort >= 60.0 - 1e-4
+        assert rshort < 1e-4  # fleet headroom covers 60 MW at this hour
+        # per-unit: dispatch + reserve never exceeds committed capacity
+        for u in GRID.thermal:
+            disp = float(np.asarray(prog.extract(f"{u.name}.base", x)))
+            for si in range(len(u.seg_mw)):
+                disp += float(np.asarray(prog.extract(f"{u.name}.seg{si}", x)))
+            r = float(np.asarray(prog.extract(f"{u.name}.reserve", x)))
+            assert disp + r <= u.p_max + 1e-4, u.name
+
+    def test_reserve_scarcity_prices_shortfall(self):
+        prog = dcopf_program(GRID, reserve=True)
+        base = self._one_hour(prog, req=0.0)
+        fleet_pmax = sum(u.p_max for u in GRID.thermal)
+        res = self._one_hour(prog, req=fleet_pmax + 100.0)  # unmeetable
+        x = res["x"][0]
+        rshort = float(np.asarray(prog.extract("reserve_shortfall", x)))
+        assert rshort > 50.0
+        # shortfall is priced into the objective at the reserve penalty
+        assert float(res["cost"][0]) > float(base["cost"][0]) + 200.0 * rshort
+
+    def test_reserve_requirement_raises_cost_monotonically(self):
+        prog = dcopf_program(GRID, reserve=True)
+        costs = [
+            float(self._one_hour(prog, req=r)["cost"][0])
+            for r in (0.0, 40.0, 80.0)
+        ]
+        assert costs[0] <= costs[1] + 1e-6 <= costs[2] + 2e-6
+
+    def test_simulator_carries_reserve_through_sced(self):
+        sim = ProductionCostSimulator(GRID)
+        assert sim.with_reserve  # dataset specifies 10 MW spin-up
+        results = sim.simulate(n_days=1)
+        assert len(results) == 24
+        rs = np.array([r["Reserve Shortfall [MW]"] for r in results])
+        np.testing.assert_allclose(rs, 0.0, atol=1e-3)
+        shed = np.array([r["Shortfall [MW]"] for r in results])
+        np.testing.assert_allclose(shed, 0.0, atol=1e-3)

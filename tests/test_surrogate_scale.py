@@ -1,0 +1,234 @@
+"""Surrogate pipeline at reference sweep scale: 10k runs x 8736 hours.
+
+Round-1 verdict item 9: prove the 10k-run path — the scale the reference's
+`Simulation_Data.py:138-221` reads (10k-run Prescient sweeps) — through the
+native mmap CSV reader (csrc), `SimulationData`, day clustering, and
+mesh-sharded Flax training, asserting R2 parity with the small-fixture run
+(`tests/test_surrogates.py`).
+
+The synthetic sweep is generated so the learning problem is real: each
+run's dispatch is a mixture of K latent day-shapes whose mixture weights
+(and revenue) are smooth functions of the swept inputs, plus noise — so
+cluster frequencies and revenue are learnable from inputs, as in the
+reference pipeline.
+
+Wall-clock budget: the whole module is a single-digit-minutes test on one
+CPU core (the CI regime here); every stage is vectorized (LUT-based CSV
+byte writer, native parallel reader, matmul-form k-means/assignment,
+one-shot bincount label generation).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dispatches_tpu.runtime import native
+from dispatches_tpu.surrogates.clustering import TimeSeriesClustering
+from dispatches_tpu.surrogates.data import SimulationData
+from dispatches_tpu.surrogates.train import TrainNNSurrogates
+
+N_RUNS = 10_000
+T = 8736
+N_DAYS = T // 24
+K_LATENT = 5
+
+
+def _synth_sweep(rng):
+    """(inputs (N,4), dispatch (N, T) f32, revenue (N,)) — dispatch built
+    from per-run mixtures of K latent day shapes, some all-zero days."""
+    h = np.arange(24)
+    # latent day prototypes: flat, morning peak, evening peak, midday solar
+    # bump, night valley — all in [0, 1]
+    protos = np.stack(
+        [
+            np.full(24, 0.55),
+            0.25 + 0.55 * np.exp(-0.5 * ((h - 8) / 2.5) ** 2),
+            0.25 + 0.55 * np.exp(-0.5 * ((h - 19) / 2.5) ** 2),
+            0.15 + 0.75 * np.exp(-0.5 * ((h - 13) / 3.5) ** 2),
+            0.65 - 0.45 * np.exp(-0.5 * ((h - 3) / 3.0) ** 2),
+        ]
+    ).astype(np.float32)
+
+    inputs = rng.uniform(0.0, 1.0, (N_RUNS, 4)).astype(np.float32)
+    # RE convention (`pmax_per_run`): input column 0 is the swept pmax in MW
+    inputs[:, 0] = 100.0 + 350.0 * inputs[:, 0]
+    pmax = inputs[:, 0]
+    inputs_unit = inputs.copy()
+    inputs_unit[:, 0] = (pmax - 100.0) / 350.0  # normalized view for the maps
+    # mixture weights: softmax of a linear map of the inputs
+    W = rng.normal(0, 1, (4, K_LATENT)).astype(np.float32)
+    logits = inputs_unit @ W
+    mix = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)  # (N, K)
+
+    # per-day prototype choice ~ Categorical(mix): vectorized via cdf search
+    u = rng.uniform(0, 1, (N_RUNS, N_DAYS)).astype(np.float32)
+    cdf = np.cumsum(mix, axis=1)
+    day_proto = (u[:, :, None] > cdf[:, None, :]).sum(2)  # (N, N_DAYS)
+
+    cf = protos[day_proto]  # (N, N_DAYS, 24)
+    cf = cf + rng.normal(0, 0.02, cf.shape).astype(np.float32)
+    cf = np.clip(cf, 0.0, 1.0)
+    # input col 3 controls the fraction of offline (all-zero) days
+    zero_frac = 0.3 * inputs_unit[:, 3]
+    zero_days = rng.uniform(0, 1, (N_RUNS, N_DAYS)) < zero_frac[:, None]
+    cf[zero_days] = 0.0
+
+    dispatch = (cf * pmax[:, None, None]).reshape(N_RUNS, T).astype(np.float32)
+    # revenue: smooth function of inputs + small noise (learnable, R2 ~ 1)
+    revenue = (
+        1e6 * inputs_unit[:, 0]
+        + 4e5 * np.sin(np.pi * inputs_unit[:, 1])
+        + 2e5 * inputs_unit[:, 2] * inputs_unit[:, 0]
+        - 3e5 * inputs_unit[:, 3]
+        + rng.normal(0, 1e4, N_RUNS)
+    ).astype(np.float32)
+    return inputs, pmax, dispatch, revenue
+
+
+@pytest.fixture(scope="module")
+def sweep(tmp_path_factory):
+    """Synthesize the sweep, write the ~half-GB CSV, read it back through
+    the native reader into SimulationData."""
+    rng = np.random.default_rng(7)
+    inputs, pmax, dispatch, revenue = _synth_sweep(rng)
+
+    d = tmp_path_factory.mktemp("sweep")
+    csv_path = os.path.join(d, "dispatch_10k.csv")
+    # LUT byte writer: quantize to 0.1 MW, one fixed-width byte string per
+    # quantized value, fancy-index + join (np.savetxt is Python-loop slow)
+    t0 = time.time()
+    q = np.round(dispatch * 10).astype(np.int32)
+    lut = np.array(
+        [(f"{v / 10:.1f},").encode() for v in range(int(q.max()) + 1)], dtype="S8"
+    )
+    with open(csv_path, "wb") as f:
+        f.write(
+            b"run," + ",".join(f"h{i}" for i in range(T)).encode() + b"\n"
+        )
+        for i in range(N_RUNS):
+            f.write(str(i).encode() + b"," + b"".join(lut[q[i]])[:-1] + b"\n")
+    write_s = time.time() - t0
+    size_mb = os.path.getsize(csv_path) / 1e6
+
+    t0 = time.time()
+    sd = SimulationData(csv_path, inputs, case_type="RE")
+    read_s = time.time() - t0
+    telemetry = {
+        "csv_mb": size_mb,
+        "write_s": write_s,
+        "read_s": read_s,
+        "read_mb_s": size_mb / max(read_s, 1e-9),
+    }
+    print(f"\n[scale] sweep CSV: {telemetry}")
+    return sd, dispatch, revenue, telemetry
+
+
+def test_native_reader_at_scale(sweep):
+    sd, dispatch, _, telem = sweep
+    assert native.native_available(), "native csrc library must be built"
+    assert sd.dispatch.shape == (N_RUNS, T)
+    assert np.array_equal(sd.index, np.arange(N_RUNS))
+    # quantized to 0.1 MW on write
+    np.testing.assert_allclose(sd.dispatch, dispatch, atol=0.051)
+    # mmap'd parallel reader: must beat 10 MB/s by a wide margin even on
+    # one core (measured ~30 MB/s here; pandas is ~3x slower)
+    assert telem["read_mb_s"] > 10.0
+
+
+def test_clustering_at_scale(sweep):
+    """K-means over ~3M kept days: centers recover the latent prototypes."""
+    sd, _, _, _ = sweep
+    cf = sd.dispatch_capacity_factors()
+    assert cf.max() <= 1.0 + 1e-6
+
+    tsc = TimeSeriesClustering(num_clusters=K_LATENT)
+    t0 = time.time()
+    res = tsc.clustering_data(
+        cf.astype(np.float32), seed=0, n_iter=20, n_init=2
+    )
+    fit_s = time.time() - t0
+    n_kept = res["labels"].shape[0]
+    print(f"\n[scale] kmeans: {n_kept} days in {fit_s:.1f}s")
+    assert n_kept > 2e6  # zero days filtered, most days kept
+
+    # every latent prototype is recovered by some center (rms < noise+quant)
+    h = np.arange(24)
+    protos = np.stack(
+        [
+            np.full(24, 0.55),
+            0.25 + 0.55 * np.exp(-0.5 * ((h - 8) / 2.5) ** 2),
+            0.25 + 0.55 * np.exp(-0.5 * ((h - 19) / 2.5) ** 2),
+            0.15 + 0.75 * np.exp(-0.5 * ((h - 13) / 3.5) ** 2),
+            0.65 - 0.45 * np.exp(-0.5 * ((h - 3) / 3.0) ** 2),
+        ]
+    )
+    centers = res["centers"]
+    for p in protos:
+        rms = np.sqrt(((centers - p[None, :]) ** 2).mean(1)).min()
+        assert rms < 0.05, f"latent prototype not recovered (rms {rms:.3f})"
+    # persistence round-trip at scale
+    sd_dir = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(sd_dir, "_scale_clustering.json")
+    try:
+        tsc.save_clustering_model(path)
+        loaded = TimeSeriesClustering.load_clustering_model(path)
+        assert loaded["cluster_centers"].shape == (K_LATENT, 24)
+    finally:
+        if os.path.exists(path):
+            os.remove(path)
+
+
+@pytest.fixture(scope="module")
+def clustering_model(sweep):
+    sd, _, _, _ = sweep
+    tsc = TimeSeriesClustering(num_clusters=K_LATENT)
+    tsc.clustering_data(
+        sd.dispatch_capacity_factors().astype(np.float32),
+        seed=0,
+        n_iter=20,
+        n_init=2,
+    )
+    return {"cluster_centers": tsc.result["centers"]}
+
+
+def test_sharded_training_at_scale(sweep, clustering_model):
+    """Frequency + revenue surrogates trained on the full 10k-run sweep,
+    data axis sharded over the 8-device mesh; R2 parity with the
+    small-fixture thresholds (`tests/test_surrogates.py`)."""
+    from dispatches_tpu.parallel.mesh import scenario_mesh
+
+    sd, _, revenue, _ = sweep
+    trainer = TrainNNSurrogates(sd, clustering_model)
+
+    t0 = time.time()
+    y = trainer.generate_label_data_frequency()
+    label_s = time.time() - t0
+    assert y.shape == (N_RUNS, K_LATENT + 2)
+    np.testing.assert_allclose(y.sum(1), 1.0, atol=1e-6)
+
+    mesh = scenario_mesh(8)
+    t0 = time.time()
+    sur_f, met_f = trainer.train_NN_frequency(
+        hidden=(64, 64), epochs=150, lr=3e-3, mesh=mesh
+    )
+    sur_r, met_r = trainer.train_NN_revenue(
+        revenue, hidden=(64, 64), epochs=500, lr=3e-3, mesh=mesh
+    )
+    train_s = time.time() - t0
+    print(
+        f"\n[scale] labels {label_s:.1f}s, train {train_s:.1f}s, "
+        f"R2(freq) {np.round(met_f['R2'], 3)}, R2(rev) {met_r['R2']}"
+    )
+    # revenue is a smooth function of inputs: near-perfect fit expected
+    assert float(np.min(met_r["R2"])) > 0.95
+    # frequency heads: mixture weights are softmax-linear in inputs — the
+    # MLP should explain most variance on every cluster head
+    assert float(np.min(met_f["R2"])) > 0.6
+    assert float(np.mean(met_f["R2"])) > 0.75
+
+    # sharded predict round-trip sanity
+    pred = np.asarray(sur_r.predict(sd.inputs))
+    assert pred.shape[0] == N_RUNS
