@@ -5,7 +5,12 @@ wind+battery operational LP with free boundary states (battery SoC and
 energy throughput), warm-starts the chunk-boundary consensus from a cheap
 time-aggregated monolithic solve, and runs the ring ADMM — sharded
 one-chunk-per-device over a mesh, or as a vmap on one device. Lands within
-~0.3-1% of the exact monolithic HiGHS optimum in tests (test_time_axis.py).
+~0.3-1% of the exact monolithic HiGHS optimum at T=48 and ~1.6-3% at
+T=336-672 with 8 chunks (test_time_axis.py): the objective stalls at the
+warm start's quality (consensus averaging cannot discover cross-chunk
+arbitrage the coarse solve missed), so this is the *fast approximate*
+multi-chip horizon path; exact year-scale solves use the block-tridiagonal
+structured IPM (`solvers/structured.py`).
 
 Reference framing: the full-year price-taker chain of
 `wind_battery_LMP.py:22-50` / `price_taker_analysis.py:181-224`, which the

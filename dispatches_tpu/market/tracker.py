@@ -30,9 +30,9 @@ class Tracker:
         tracking_model_object,
         tracking_horizon: int,
         n_tracking_hour: int = 1,
-        tracking_penalty: float = 1000.0,  # $/MWh deviation
-        curtailment_cost: float = 0.1,  # $/MWh tie-break: prefer storing to spilling
-        cycling_cost: float = 0.01,  # $/MWh on battery throughput: no charge/discharge loops
+        tracking_penalty: Optional[float] = None,  # $/MWh deviation (default 1000; 100 in f32)
+        curtailment_cost: Optional[float] = None,  # $/MWh tie-break: prefer storing to spilling (default 0.1; 10 in f32)
+        cycling_cost: Optional[float] = None,  # $/MWh on battery throughput: no charge/discharge loops (default 0.01; 1 in f32)
         solver_kw: Optional[dict] = None,
         dtype=None,
     ):
@@ -40,23 +40,26 @@ class Tracker:
         self.tracking_horizon = tracking_horizon
         self.n_tracking_hour = n_tracking_hour
         self.dtype = jnp.dtype(dtype) if dtype is not None else jnp.result_type(float)
+        f64 = self.dtype == jnp.float64
         # tight default tolerance: the tie-break costs are ~1e-4 of the
         # deviation penalty and must still be resolved to pick the vertex.
         # In f32 the tight target is unreachable (eps ~ 1e-7); use the
         # tightest tolerance the dtype can actually certify.
-        default_tol = 1e-10 if self.dtype == jnp.float64 else 3e-6
-        self.solver_kw = {"tol": default_tol, **(solver_kw or {})}
-        # f32 rescaling: the objective is normalized by max|c| (~the
-        # deviation penalty), so a tie-break at 1e-4 of the penalty lands
-        # below the f32-achievable duality gap and the store-don't-spill
-        # vertex is not resolved. Compress the dynamic range instead of
-        # tightening the tolerance: a 10x smaller penalty (still >> all
-        # physical costs) and 100x larger tie-breaks (still 10x below the
-        # penalty) put every coefficient inside f32's resolvable window.
-        if self.dtype != jnp.float64:
-            tracking_penalty *= 0.1
-            curtailment_cost *= 100.0
-            cycling_cost *= 100.0
+        self.solver_kw = {"tol": 1e-10 if f64 else 3e-6, **(solver_kw or {})}
+        # dtype-aware defaults (explicit caller values are respected): the
+        # objective is normalized by max|c| (~the deviation penalty), so in
+        # f32 a tie-break at 1e-4 of the penalty lands below the achievable
+        # duality gap and the store-don't-spill vertex is not resolved.
+        # Compress the dynamic range instead of tightening the tolerance:
+        # a 10x smaller penalty (still >> all physical costs) and 100x
+        # larger tie-breaks (still 10x below the penalty) put every
+        # coefficient inside f32's resolvable window.
+        if tracking_penalty is None:
+            tracking_penalty = 1000.0 if f64 else 100.0
+        if curtailment_cost is None:
+            curtailment_cost = 0.1 if f64 else 10.0
+        if cycling_cost is None:
+            cycling_cost = 0.01 if f64 else 1.0
 
         T = tracking_horizon
         m, power_out_mw = tracking_model_object.build_program(T)

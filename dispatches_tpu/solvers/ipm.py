@@ -93,7 +93,13 @@ def solve_lp(
     # TPU f32 matmuls default to bf16 passes, which destroys the
     # normal-equations Cholesky (round-1 bench: 0/416 converged). Force full
     # f32 accumulation for every dot/cholesky in the solve; no-op on CPU/f64.
-    with jax.default_matmul_precision("highest"):
+    # DISPATCHES_TPU_MATMUL_PRECISION=high trades one bf16 refinement pass
+    # (6 -> 3) for speed — measured safe on the weekly price-taker batch but
+    # not the default; "highest" is the conservative contract.
+    import os
+
+    prec = os.environ.get("DISPATCHES_TPU_MATMUL_PRECISION", "highest")
+    with jax.default_matmul_precision(prec):
         return _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q)
 
 

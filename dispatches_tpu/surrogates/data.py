@@ -70,9 +70,16 @@ class SimulationData:
 
         df = pd.read_csv(path, nrows=num_sims)
         run_index = df.iloc[:, 0].to_numpy(dtype=str)
-        index = np.array(
-            [int(re.split(r"_|\.", r)[1]) for r in run_index], dtype=int
-        )
+        # labels are either plain run numbers ("37") or reference-style
+        # ("run_37" / "run_37.csv") — both formats must parse on the pandas
+        # path too (the native library may be unavailable)
+        def parse(r: str) -> int:
+            digits = re.findall(r"\d+", r)
+            if not digits:
+                raise ValueError(f"unparseable run label {r!r}")
+            return int(digits[0])
+
+        index = np.array([parse(r) for r in run_index], dtype=int)
         return df.iloc[:, 1:].to_numpy(dtype=float), index
 
     @staticmethod

@@ -35,22 +35,26 @@ T = 8736
 N_DAYS = T // 24
 K_LATENT = 5
 
+# latent day prototypes: flat, morning peak, evening peak, midday solar
+# bump, night valley — all in [0, 1]. Shared between the sweep generator
+# and the recovery assertion (they must stay identical for the rms check
+# to be an oracle).
+_H = np.arange(24)
+PROTOS = np.stack(
+    [
+        np.full(24, 0.55),
+        0.25 + 0.55 * np.exp(-0.5 * ((_H - 8) / 2.5) ** 2),
+        0.25 + 0.55 * np.exp(-0.5 * ((_H - 19) / 2.5) ** 2),
+        0.15 + 0.75 * np.exp(-0.5 * ((_H - 13) / 3.5) ** 2),
+        0.65 - 0.45 * np.exp(-0.5 * ((_H - 3) / 3.0) ** 2),
+    ]
+).astype(np.float32)
+
 
 def _synth_sweep(rng):
     """(inputs (N,4), dispatch (N, T) f32, revenue (N,)) — dispatch built
     from per-run mixtures of K latent day shapes, some all-zero days."""
-    h = np.arange(24)
-    # latent day prototypes: flat, morning peak, evening peak, midday solar
-    # bump, night valley — all in [0, 1]
-    protos = np.stack(
-        [
-            np.full(24, 0.55),
-            0.25 + 0.55 * np.exp(-0.5 * ((h - 8) / 2.5) ** 2),
-            0.25 + 0.55 * np.exp(-0.5 * ((h - 19) / 2.5) ** 2),
-            0.15 + 0.75 * np.exp(-0.5 * ((h - 13) / 3.5) ** 2),
-            0.65 - 0.45 * np.exp(-0.5 * ((h - 3) / 3.0) ** 2),
-        ]
-    ).astype(np.float32)
+    protos = PROTOS
 
     inputs = rng.uniform(0.0, 1.0, (N_RUNS, 4)).astype(np.float32)
     # RE convention (`pmax_per_run`): input column 0 is the swept pmax in MW
@@ -138,7 +142,7 @@ def test_native_reader_at_scale(sweep):
     assert telem["read_mb_s"] > 10.0
 
 
-def test_clustering_at_scale(sweep):
+def test_clustering_at_scale(sweep, tmp_path):
     """K-means over ~3M kept days: centers recover the latent prototypes."""
     sd, _, _, _ = sweep
     cf = sd.dispatch_capacity_factors()
@@ -155,30 +159,15 @@ def test_clustering_at_scale(sweep):
     assert n_kept > 2e6  # zero days filtered, most days kept
 
     # every latent prototype is recovered by some center (rms < noise+quant)
-    h = np.arange(24)
-    protos = np.stack(
-        [
-            np.full(24, 0.55),
-            0.25 + 0.55 * np.exp(-0.5 * ((h - 8) / 2.5) ** 2),
-            0.25 + 0.55 * np.exp(-0.5 * ((h - 19) / 2.5) ** 2),
-            0.15 + 0.75 * np.exp(-0.5 * ((h - 13) / 3.5) ** 2),
-            0.65 - 0.45 * np.exp(-0.5 * ((h - 3) / 3.0) ** 2),
-        ]
-    )
     centers = res["centers"]
-    for p in protos:
+    for p in PROTOS:
         rms = np.sqrt(((centers - p[None, :]) ** 2).mean(1)).min()
         assert rms < 0.05, f"latent prototype not recovered (rms {rms:.3f})"
     # persistence round-trip at scale
-    sd_dir = os.path.dirname(os.path.abspath(__file__))
-    path = os.path.join(sd_dir, "_scale_clustering.json")
-    try:
-        tsc.save_clustering_model(path)
-        loaded = TimeSeriesClustering.load_clustering_model(path)
-        assert loaded["cluster_centers"].shape == (K_LATENT, 24)
-    finally:
-        if os.path.exists(path):
-            os.remove(path)
+    path = os.path.join(tmp_path, "_scale_clustering.json")
+    tsc.save_clustering_model(path)
+    loaded = TimeSeriesClustering.load_clustering_model(path)
+    assert loaded["cluster_centers"].shape == (K_LATENT, 24)
 
 
 @pytest.fixture(scope="module")
