@@ -153,20 +153,24 @@ def main():
     yprog, _ = build_pricetaker(ydesign)
     ylmp = np.tile(lmp_weeks.reshape(-1), 2)[:Ty] * rng.uniform(0.95, 1.05, Ty)
     ycf = np.tile(cf_weeks.reshape(-1), 2)[:Ty]
-    ymeta = extract_time_structure(yprog, Ty, block_hours=120)
+    # substructured (SPIKE) decomposition: 8 slabs of 15 blocks — measured
+    # ~1.35x faster than the best sequential-scan config (bh=120) on one
+    # chip, and the same code shards one-slab-per-device on a mesh
+    ymeta = extract_time_structure(yprog, Ty, block_hours=73)
     yparams = {
         "lmp": jnp.asarray(ylmp, jnp.float32),
         "wind_cf": jnp.asarray(ycf, jnp.float32),
     }
+    ykw = dict(tol=1e-5, max_iter=80, refine_steps=3, slabs=8)
     yblp = ymeta.instantiate(yparams, dtype=jnp.float32)
-    ysol = solve_lp_banded(ymeta, yblp, tol=1e-5, max_iter=80, refine_steps=3)
+    ysol = solve_lp_banded(ymeta, yblp, **ykw)
     np.asarray(ysol.obj)  # sync (warm compile)
     yblp2 = ymeta.instantiate(
         {"lmp": yparams["lmp"] * (1 + 1e-6), "wind_cf": yparams["wind_cf"]},
         dtype=jnp.float32,
     )
     t0 = time.perf_counter()
-    ysol = solve_lp_banded(ymeta, yblp2, tol=1e-5, max_iter=80, refine_steps=3)
+    ysol = solve_lp_banded(ymeta, yblp2, **ykw)
     yconv = bool(np.asarray(ysol.converged))
     ydt = time.perf_counter() - t0
 
@@ -177,7 +181,7 @@ def main():
                 f"(T=168h, batch={B}, converged={conv_frac:.3f}, "
                 f"median_iters={med_iters:.0f}, max_rel_err_vs_highs={rel_err:.1e}; "
                 f"year-scale: one 8760h monolithic design LP in {ydt:.1f}s "
-                f"f32 block-tridiag IPM, converged={yconv})",
+                f"f32 block-tridiag IPM 8-slab SPIKE, converged={yconv})",
                 "value": round(solves_per_sec, 3),
                 "unit": "solves/sec",
                 "vs_baseline": round(solves_per_sec / cpu_solves_per_sec, 2),

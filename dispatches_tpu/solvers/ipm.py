@@ -24,6 +24,16 @@ from jax import lax
 
 from ..core.program import LPData
 
+# Read ONCE at import: solve_lp traces under jit, so the chosen precision is
+# baked into each trace cache — a mid-process env change could not take
+# effect anyway and would only desynchronize the cache from the flag.
+# DISPATCHES_TPU_MATMUL_PRECISION=high trades bf16 refinement passes (6 -> 3)
+# for speed — measured numerically safe on the weekly price-taker batch but
+# no faster there, so "highest" stays the conservative default.
+import os as _os
+
+_MATMUL_PRECISION = _os.environ.get("DISPATCHES_TPU_MATMUL_PRECISION", "highest")
+
 
 class IPMSolution(NamedTuple):
     x: jnp.ndarray
@@ -93,13 +103,7 @@ def solve_lp(
     # TPU f32 matmuls default to bf16 passes, which destroys the
     # normal-equations Cholesky (round-1 bench: 0/416 converged). Force full
     # f32 accumulation for every dot/cholesky in the solve; no-op on CPU/f64.
-    # DISPATCHES_TPU_MATMUL_PRECISION=high trades one bf16 refinement pass
-    # (6 -> 3) for speed — measured safe on the weekly price-taker batch but
-    # not the default; "highest" is the conservative contract.
-    import os
-
-    prec = os.environ.get("DISPATCHES_TPU_MATMUL_PRECISION", "highest")
-    with jax.default_matmul_precision(prec):
+    with jax.default_matmul_precision(_MATMUL_PRECISION):
         return _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q)
 
 

@@ -438,10 +438,145 @@ def _shift_down(a):
     return jnp.concatenate([jnp.zeros_like(a[:1]), a[:-1]])
 
 
-def _banded_ops(Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None):
+def _shift_up(a):
+    """a[t] -> a[t+1] content: out[-1]=0, out[t]=a[t+1]."""
+    return jnp.concatenate([a[1:], jnp.zeros_like(a[:1])])
+
+
+# ----------------------------------------------------------------------
+# Substructured (SPIKE / domain-decomposition) block-tridiagonal solve
+# ----------------------------------------------------------------------
+# Partition the Tb blocks into D contiguous slabs of S = Tb/D. Interface
+# unknowns are each slab's LAST block; the S-1 interior blocks of every
+# slab form an independent block-tridiagonal chain once the interfaces are
+# removed. Eliminating the interiors (a vmap over slabs — critical path
+# S-1 instead of Tb) leaves a D-block tridiagonal Schur system on the
+# interfaces, solved by the same scan at length D. This is the exact
+# multi-chip decomposition of the time axis: slabs map one-per-device, the
+# interior work is embarrassingly parallel, and only the small interface
+# blocks are exchanged — the "long-context" analogue of ring attention's
+# blockwise decomposition, but algebraically exact.
+class _SlabFactors(NamedTuple):
+    Ls_int: jnp.ndarray  # (D, S-1, mB, mB) interior chain Cholesky diag
+    Cs_int: jnp.ndarray  # (D, S-1, mB, mB) interior chain sub-diag
+    X: jnp.ndarray  # (D, S-1, mB, mB) K_int^-1 F_prev (prev-interface spike)
+    Y: jnp.ndarray  # (D, S-1, mB, mB) K_int^-1 F_self (self-interface spike)
+    Ls_schur: jnp.ndarray  # (D, mB, mB) interface Schur Cholesky diag
+    Cs_schur: jnp.ndarray  # (D, mB, mB) interface Schur sub-diag
+    E_prev: jnp.ndarray  # (D, mB, mB) E at each slab's first block
+    E_self: jnp.ndarray  # (D, mB, mB) E at each slab's interface block
+
+
+def _slab_split(Ds, Es, D):
+    """(Tb, mB, mB) block arrays -> interior (D, S-1, mB, mB), interface
+    diagonal (D, mB, mB), and the two coupling E blocks per slab."""
+    Tb, mB = Ds.shape[0], Ds.shape[1]
+    S = Tb // D
+    Dr = Ds.reshape(D, S, mB, mB)
+    Er = Es.reshape(D, S, mB, mB)
+    D_int = Dr[:, : S - 1]
+    D_ifc = Dr[:, S - 1]
+    E_int = Er[:, : S - 1]  # E_int[d, 0] couples slab d's first block to I_{d-1}
+    E_self = Er[:, S - 1]  # rows I_d, cols interior block S-2
+    E_prev = E_int[:, 0]
+    # interior chains must not see the slab-crossing coupling: zero block 0's E
+    E_chain = E_int.at[:, 0].set(jnp.zeros_like(E_prev))
+    return S, D_int, D_ifc, E_chain, E_prev, E_self
+
+
+def _slab_shard(mesh, axis):
+    """Constraint helper: shard an array's leading slab axis over `mesh`
+    (identity when mesh is None). With the constraint in place XLA's SPMD
+    partitioner runs each slab's interior factorization/solve on its own
+    device and inserts the interface collectives itself — the 'annotate
+    shardings, let the compiler place collectives' idiom."""
+    if mesh is None:
+        return lambda a: a
+    from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+    sh = NamedSharding(mesh, PSpec(axis))
+    return lambda a: jax.lax.with_sharding_constraint(a, sh)
+
+
+def _slab_chol(Ds, Es, D, mesh=None, axis="time") -> _SlabFactors:
+    """Factor the block-tridiagonal SPD system by substructuring: interior
+    chains (vmapped `_block_chol` over slabs) + interface Schur complement.
+    With `mesh`, the slab axis is sharded one-slab-per-device."""
+    S, D_int, D_ifc, E_chain, E_prev, E_self = _slab_split(Ds, Es, D)
+    mB = Ds.shape[1]
+    shard = _slab_shard(mesh, axis)
+    D_int, E_chain = shard(D_int), shard(E_chain)
+
+    Ls_int, Cs_int = jax.vmap(_block_chol)(D_int, E_chain)
+    Ls_int, Cs_int = shard(Ls_int), shard(Cs_int)
+    solve_int = jax.vmap(_bt_solve)  # over slabs
+
+    # spikes: K_int^-1 applied to the (block-sparse) coupling columns —
+    # one solve with both column groups stacked (the interior scan is the
+    # critical path; two sequential scans would double it)
+    F_prev = jnp.zeros_like(D_int).at[:, 0].set(E_prev)
+    F_self = jnp.zeros_like(D_int).at[:, S - 2].set(
+        jnp.swapaxes(E_self, -1, -2)
+    )
+    XY = shard(
+        solve_int(
+            Ls_int, Cs_int, shard(jnp.concatenate([F_prev, F_self], axis=-1))
+        )
+    )
+    X, Y = XY[..., :mB], XY[..., mB:]
+
+    # Schur diagonal: D_I[d] - E_self[d] Y[d, S-2] - E_prev[d+1]^T X[d+1, 0]
+    t_self = jnp.einsum("dij,djk->dik", E_self, Y[:, S - 2])
+    t_prev = jnp.einsum("dji,djk->dik", E_prev, X[:, 0])  # E^T X0, lands at d-1
+    S_diag = D_ifc - t_self - _shift_up(t_prev)
+    # Schur sub-diagonal (rows I_d, cols I_{d-1}): -E_self[d] X[d, S-2]
+    S_sub = -jnp.einsum("dij,djk->dik", E_self, X[:, S - 2])
+    S_sub = S_sub.at[0].set(jnp.zeros_like(S_sub[0]))
+    Ls_schur, Cs_schur = _block_chol(S_diag, S_sub)
+    return _SlabFactors(Ls_int, Cs_int, X, Y, Ls_schur, Cs_schur, E_prev, E_self)
+
+
+def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time"):
+    """Solve using `_slab_chol` factors; r is (Tb, mB) or (Tb, mB, k)."""
+    vec = r.ndim == 2
+    if vec:
+        r = r[..., None]
+    D, Sm1 = f.X.shape[0], f.X.shape[1]
+    S = Sm1 + 1
+    mB, k = r.shape[1], r.shape[2]
+    shard = _slab_shard(mesh, axis)
+    rr = r.reshape(D, S, mB, k)
+    r_int, r_ifc = shard(rr[:, : S - 1]), rr[:, S - 1]
+
+    h = shard(jax.vmap(_bt_solve)(f.Ls_int, f.Cs_int, r_int))  # K_int^-1 r
+    # interface RHS: g_d = r_I[d] - E_self[d] h[d, S-2] - E_prev[d+1]^T h[d+1, 0]
+    g = r_ifc - jnp.einsum("dij,djk->dik", f.E_self, h[:, S - 2])
+    g = g - _shift_up(jnp.einsum("dji,djk->dik", f.E_prev, h[:, 0]))
+    x_ifc = _bt_solve(f.Ls_schur, f.Cs_schur, g)  # (D, mB, k)
+
+    # back-substitute: x_int = h - X x_I[d-1] - Y x_I[d]
+    x_prev = _shift_down(x_ifc)
+    x_int = shard(
+        h
+        - jnp.einsum("dsij,djk->dsik", f.X, x_prev)
+        - jnp.einsum("dsij,djk->dsik", f.Y, x_ifc)
+    )
+    out = jnp.concatenate([x_int, x_ifc[:, None]], axis=1).reshape(-1, mB, k)
+    return out[..., 0] if vec else out
+
+
+def _banded_ops(
+    Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None, slabs=None, mesh=None
+):
     """(matvec, rmatvec, make_kkt_solver) for `ipm._solve_scaled`, operating
     on flat vectors laid out [Tb*nB time-cols | p border-cols] (x-space) and
     [Tb*mB] (y-space).
+
+    `slabs=D` switches the KKT factorization/solve from the sequential
+    Tb-step scan to the substructured (SPIKE) decomposition: D parallel
+    interior chains of Tb/D-1 blocks + a D-block interface Schur system —
+    the critical path drops from Tb to Tb/D + D, and the slab axis is the
+    exact multi-chip time decomposition (requires Tb % D == 0, Tb/D >= 2).
 
     `pad_rows` (Tb, mB) marks all-zero padding rows: they get a UNIT
     diagonal in the normal equations instead of just reg_d. Their RHS is
@@ -483,10 +618,17 @@ def _banded_ops(Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None):
         Ds = Ds + jnp.einsum("tij,tj,tkj->tik", As, wprev, As)
         Ds = Ds + diag_shift
         Es = jnp.einsum("tij,tj,tkj->tik", As, wprev, _shift_down(Ad))
-        Ls, Cs = _block_chol(Ds, Es)
+        if slabs:
+            fac = _slab_chol(Ds, Es, slabs, mesh=mesh)
 
-        def base(rt):
-            return _bt_solve(Ls, Cs, rt)
+            def base(rt):
+                return _slab_solve(fac, rt, mesh=mesh)
+
+        else:
+            Ls, Cs = _block_chol(Ds, Es)
+
+            def base(rt):
+                return _bt_solve(Ls, Cs, rt)
 
         if p:
             # Woodbury: K = Kb + B diag(wb) B^T
@@ -558,9 +700,13 @@ def _ruiz_banded(Ad, As, Bb, iters: int = 8):
 
 
 @partial(
-    jax.jit, static_argnames=("meta", "max_iter", "refine_steps", "d_cap")
+    jax.jit,
+    static_argnames=("meta", "max_iter", "refine_steps", "d_cap", "slabs", "mesh"),
 )
-def _solve_banded_jit(meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap):
+def _solve_banded_jit(
+    meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs=None,
+    mesh=None,
+):
     Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0 = blp
     dtype = Ad.dtype
     Tb, mB, nB = Ad.shape
@@ -588,7 +734,8 @@ def _solve_banded_jit(meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_ca
         )
 
         ops = _banded_ops(
-            Ad_s, As_s, Bb_s, Tb, mB, nB, p, reg_d, pad_rows=meta.pad_rows
+            Ad_s, As_s, Bb_s, Tb, mB, nB, p, reg_d,
+            pad_rows=meta.pad_rows, slabs=slabs, mesh=mesh,
         )
         sol = _solve_scaled(
             LPData(
@@ -642,11 +789,25 @@ def solve_lp_banded(
     reg_d: float = None,
     refine_steps: int = 2,
     d_cap: float = None,
+    slabs: int = None,
+    mesh=None,
+    mesh_axis: str = "time",
 ) -> IPMSolution:
     """Solve a time-banded LP by the block-tridiagonal IPM. Returns a
     solution with ``x`` in the CompiledLP's reduced column order, so
     `prog.extract` / `prog.eval_expr` work unchanged; ``y`` is in the
     banded row order (use ``meta.row_pos_flat`` to map duals).
+
+    ``slabs=D`` uses the substructured (SPIKE) KKT factorization — D
+    parallel interior chains + a D-block interface Schur system — instead
+    of the sequential Tb-step scan; algebraically exact, critical path
+    Tb/D + D. Requires meta.Tb % D == 0 with Tb/D >= 2. With ``mesh`` (a
+    `jax.sharding.Mesh` whose ``mesh_axis`` has D devices), the slab axis
+    is sharded one-slab-per-device via sharding constraints — XLA's SPMD
+    partitioner distributes the interior factorizations and inserts the
+    interface collectives; only the small interface Schur blocks move
+    between devices. This is the exact multi-chip year-horizon path (the
+    approximate one is `parallel/time_axis.py`'s consensus ADMM).
 
     In f32 the barrier weights are capped (`d_cap`, default 1e12): the
     uncapped z/x spread breaks long block-factorization chains on some LMP
@@ -659,8 +820,38 @@ def solve_lp_banded(
         reg_d = 1e-12 if dtype == jnp.float64 else 1e-7
     if d_cap is None and dtype != jnp.float64:
         d_cap = 1e12
+    if slabs:
+        if meta.Tb % slabs or meta.Tb // slabs < 2:
+            raise ValueError(
+                f"slabs={slabs} needs Tb divisible with quotient >= 2 "
+                f"(Tb={meta.Tb})"
+            )
+    if mesh is not None:
+        if not slabs:
+            raise ValueError("mesh requires slabs (one slab per device)")
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                f"mesh must have exactly one axis (got {mesh.axis_names}); "
+                "the slab decomposition shards only the time axis"
+            )
+        if mesh_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh has no axis '{mesh_axis}' (axes: {mesh.axis_names})"
+            )
+        if mesh.shape[mesh_axis] != slabs:
+            raise ValueError(
+                f"mesh axis '{mesh_axis}' has {mesh.shape[mesh_axis]} "
+                f"devices, need {slabs} (one per slab)"
+            )
+        if mesh_axis != "time":
+            # _slab_chol/_slab_solve name their constraint axis "time";
+            # rename the (single) axis so the names line up
+            from jax.sharding import Mesh
+
+            mesh = Mesh(mesh.devices, ("time",))
     return _solve_banded_jit(
-        meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap
+        meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs,
+        mesh,
     )
 
 
