@@ -1,7 +1,7 @@
-"""Fossil (ultra-supercritical + thermal storage) case study
-(the analogue of `dispatches/case_studies/fossil_case/`)."""
+"""Fossil (ultra-supercritical + supercritical + thermal storage) case
+study (the analogue of `dispatches/case_studies/fossil_case/`)."""
 
-from . import usc_plant
+from . import scpc_nlp, usc_plant
 from .multiperiod import MultiPeriodUsc, build_usc_storage_model, salt_flow_per_mw
 from .pricetaker import (
     MOD_RTS_LMP_24,

@@ -1,0 +1,266 @@
+"""Supercritical (SCPC) steam-cycle NLP — the reference's second plant tier.
+
+A faithful reduced re-build of
+`fossil_case/supercritical_plant/supercritical_powerplant.py` (1,090 LoC):
+the 9-stage turbine train with one reheat, seven closed feedwater heaters
+with UA-LMTD condensing heat transfer and cascading drains, the deaerator
+(fwh_mix 5), condensate and boiler-feed pumps, and the boiler-feed-pump
+turbine (BFPT) power balance. All fixed data (stage pressure
+ratios/efficiencies, reheater ΔP, FWH areas/OHTC, pump data) are the
+reference's `fix_dof_and_initialize` values (`:580-724`), and the drain
+throttling convention is its `fwh` pressure-ratio list (`:243-270`).
+
+Differences from the USC tier (`usc_nlp.py`) mirror the reference pair:
+one reheat instead of two, 9 stages instead of 11, 7 FWHs instead of 9,
+no booster pump (the deaerator feeds the BFP directly), a fixed 1 MPa
+condensate-pump ΔP, and the BFPT balancing ONLY the BFP
+(`supercritical_powerplant.py:372-377` analogue) while the condensate
+pump's work is netted off the plant output (`:387-399`:
+net_power = -(Σ turbine work + cond_pump work)).
+
+The square system: 7 FWH extraction fractions + 7 feedwater outlet
+enthalpies + the BFPT fraction = 15 unknowns; 7 shell/tube energy
+balances + 7 UA-LMTD equations + the BFPT power balance = 15 equations,
+solved by `solvers/nlp.solve_square` (autodiff Jacobian, damped Newton).
+
+Golden (reference `tests/test_scpc_flowsheet.py:52`): net power
+692 MW ± 1 at design throttle (24.235 MPa, 29,111 mol/s, 866.15 K).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...properties import steam as st
+from ...solvers.nlp import solve_square
+
+MW_H2O = 0.01801528  # kg/mol
+
+# ---- reference data (`fix_dof_and_initialize`, `:622-698`) ---------------
+MAIN_FLOW_MOL = 29111.0
+MAIN_STEAM_P = 24235081.4
+MAIN_STEAM_T = 866.15
+RATIO_P = np.array(
+    [0.8**5, 0.8**2, 0.79**4, 0.79**6, 0.64**2, 0.64**2, 0.64**2, 0.64**2, 0.5]
+)
+TURB_EFF = np.array([0.94, 0.94, 0.88, 0.88, 0.78, 0.78, 0.78, 0.78, 0.78])
+RH_DELTAP = {3: 96526.64}  # single reheat before stage 3 (`:625`, NETL ΔP)
+DEA_SPLIT = 0.050331  # t_splitter[4] -> deaerator (fixed, `:662`)
+COND_PUMP_DP = 1e6  # Pa (`:688`)
+BFP_P_RATIO = 1.15  # bfp outlet = main steam pressure * 1.15 (`:696`)
+PUMP_EFF = 0.8
+BFPT_EFF = 0.8
+FWH_AREA = {1: 400.0, 2: 300.0, 3: 200.0, 4: 200.0, 6: 600.0, 7: 400.0, 8: 400.0}
+FWH_U = {1: 2000.0, 2: 2900.0, 3: 2900.0, 4: 2900.0, 6: 2900.0, 7: 2900.0, 8: 2900.0}
+# shell-side drain throttle: P_drain = 1.1 * ratio * P_extraction
+# (`pressure_ratio_list`, `:243-270`)
+FWH_DRAIN_RATIO = {1: 0.5, 2: 0.64**2, 3: 0.64**2, 4: 0.64**2,
+                   6: 0.79**6, 7: 0.79**4, 8: 0.8**2}
+FWH_TUBE_DP_RATIO = 0.96  # 4% feedwater-side drop (`:255-262` analogue)
+
+# extraction topology (`split_fwh_map`, `:461-468`): splitter k -> consumer
+#   1->fwh8  2->fwh7  3->fwh6  4->deaerator(+bfpt via outlet_3)
+#   5->fwh4  6->fwh3  7->fwh2  8->fwh1
+FWH_OF_SPLIT = {1: 8, 2: 7, 3: 6, 5: 4, 6: 3, 7: 2, 8: 1}
+SPLIT_OF_FWH = {v: k for k, v in FWH_OF_SPLIT.items()}
+
+# reference initialization split fractions (`:717-724`) — Newton start
+INIT_FRACS = np.array(
+    [0.12812, 0.061824, 0.03815, 0.0381443, 0.017535, 0.0154, 0.00121]
+)  # splitter order: s1(fwh8) s2 s3 s5(fwh4) s6 s7 s8
+INIT_BFPT = 1.0 - 0.9019 - DEA_SPLIT  # splitter 4 remainder (`:715`)
+
+
+class SCPCResult(NamedTuple):
+    power_mw: jnp.ndarray  # net: Σ turbine work - condensate-pump work
+    heat_duty_mw: jnp.ndarray  # boiler + reheater
+    boiler_flow_mol: jnp.ndarray
+    fracs: jnp.ndarray  # (7,) FWH extraction fractions, splitter order
+    bfpt_frac: jnp.ndarray
+    h_fw: jnp.ndarray  # (7,) feedwater outlet enthalpies [J/kg], fwh order
+    residual: jnp.ndarray
+
+
+def _lmtd_underwood(dt1, dt2):
+    a = jnp.maximum(dt1, 1e-2) ** (1.0 / 3.0)
+    b = jnp.maximum(dt2, 1e-2) ** (1.0 / 3.0)
+    return (0.5 * (a + b)) ** 3
+
+
+# index of each FWH in the h_fw / tube-pressure vectors (fwh1..4, 6..8)
+FWH_LIST = (1, 2, 3, 4, 6, 7, 8)
+POS_OF_FWH = {f: i for i, f in enumerate(FWH_LIST)}
+
+
+def _cycle_residuals(x, params):
+    """15-equation square system. x = [fracs(7), bfpt_frac, h_fw(7)] with
+    h_fw scaled 1e-6 (J/kg -> MJ/kg) for Newton conditioning."""
+    P_main = params["P_main"]
+    flow_mol = params["flow_mol"]
+    mflow = flow_mol * MW_H2O
+
+    fracs = x[:7]
+    f_bfpt = x[7]
+    h_fw = x[8:15] * 1e6  # fwh1..4, 6..8 tube-outlet enthalpies [J/kg]
+
+    # ---- turbine train forward pass -----------------------------------
+    split_of_stage = {1: fracs[0], 2: fracs[1], 3: fracs[2],
+                      4: DEA_SPLIT + f_bfpt, 5: fracs[3], 6: fracs[4],
+                      7: fracs[5], 8: fracs[6]}
+    P_in = P_main
+    h_in = st.props_vapor(P_in, MAIN_STEAM_T).h
+    T_in = MAIN_STEAM_T
+    flow = mflow
+    W = 0.0
+    Q_rh = 0.0
+    ext = {}
+    h_boiler_out = h_in
+    for k in range(1, 10):
+        if k in RH_DELTAP:
+            P2 = P_in - RH_DELTAP[k]
+            h2 = st.props_vapor(P2, MAIN_STEAM_T).h
+            Q_rh = Q_rh + flow * (h2 - h_in)
+            P_in, h_in, T_in = P2, h2, MAIN_STEAM_T
+        P_out = RATIO_P[k - 1] * P_in
+        # (P, h) expansion: SC stages 8-9 ingest WET steam after the single
+        # reheat — the (P, T) form would reset their inlets to dry
+        # saturated vapor and overstate the train work
+        ex = st.turbine_expansion_ph(P_in, h_in, P_out, TURB_EFF[k - 1])
+        W = W + flow * (h_in - ex.h_out)
+        h_in, T_in, P_in = ex.h_out, ex.T_out, P_out
+        if k in split_of_stage:
+            ext[k] = (flow, h_in, P_out, T_in)
+            flow = flow * (1.0 - split_of_stage[k])
+    P_cond = P_in  # stage-9 exhaust: the condenser pressure
+
+    # ---- feedwater-side pressures (4% tube drop per FWH) ---------------
+    P_dea = ext[4][2]
+    r = FWH_TUBE_DP_RATIO
+    P_lp0 = P_cond + COND_PUMP_DP
+    P_hp0 = MAIN_STEAM_P * BFP_P_RATIO  # bfp outlet held at DESIGN pressure
+    P_fw_in = jnp.array(
+        [P_lp0, P_lp0 * r, P_lp0 * r**2, P_lp0 * r**3,  # fwh1..4
+         P_hp0, P_hp0 * r, P_hp0 * r**2]  # fwh6..8
+    )
+    P_fw_out = P_fw_in * r  # fwh8 outlet = boiler inlet
+
+    # ---- mass bookkeeping ---------------------------------------------
+    e = {k: ext[k][0] * split_of_stage[k] for k in ext}
+    e_fwh = {FWH_OF_SPLIT[k]: e[k] for k in FWH_OF_SPLIT}
+    e_dea = ext[4][0] * DEA_SPLIT
+    e_bfpt = ext[4][0] * f_bfpt
+    # condensate flow through fwh1..4 = everything reaching the condenser:
+    # stage-9 exhaust + LP drains + BFPT exhaust (`:563`, bfpt -> condenser
+    # mix) — only the HP extractions and deaerator steam bypass it
+    cond_flow = mflow - (e_fwh[8] + e_fwh[7] + e_fwh[6] + e_dea)
+    tube_flow = {1: cond_flow, 2: cond_flow, 3: cond_flow, 4: cond_flow,
+                 6: mflow, 7: mflow, 8: mflow}
+
+    # ---- drain states: saturated liquid at 1.1 * ratio * P_extraction --
+    P_drain = {
+        i: 1.1 * FWH_DRAIN_RATIO[i] * ext[SPLIT_OF_FWH[i]][2] for i in FWH_LIST
+    }
+    hf = {i: st.sat_liquid(P_drain[i]).h for i in FWH_LIST}
+    T_drain = {i: st.sat_temperature(P_drain[i]) for i in FWH_LIST}
+
+    # drain cascades (`:536`): HP 8->7->6->deaerator, LP 4->3->2->1->cond
+    drain_hp = {8: e_fwh[8]}
+    for i in (7, 6):
+        drain_hp[i] = drain_hp[i + 1] + e_fwh[i]
+    drain_lp = {4: e_fwh[4]}
+    for i in (3, 2, 1):
+        drain_lp[i] = drain_lp[i + 1] + e_fwh[i]
+
+    # ---- pumps and the feedwater chain ---------------------------------
+    h_cond = st.sat_liquid(P_cond).h
+    T_cond = st.sat_temperature(P_cond)
+    w_pump_spec = st.pump_work(P_cond, P_lp0, T_cond, PUMP_EFF)
+    w_cond_pump = cond_flow * w_pump_spec
+    h0 = h_cond + w_pump_spec
+
+    # deaerator: feed (fwh4 out) + steam + fwh6 drain -> saturated-ish mix
+    h_dea_out = (
+        cond_flow * h_fw[POS_OF_FWH[4]] + e_dea * ext[4][1] + drain_hp[6] * hf[6]
+    ) / mflow
+    T_dea = st.temperature_ph_liquid(P_dea, h_dea_out)
+    w_bfp_spec = st.pump_work(P_dea, P_hp0, T_dea, PUMP_EFF)
+    w_bfp = mflow * w_bfp_spec
+    h_bfp_out = h_dea_out + w_bfp_spec
+
+    h_in_fw = {1: h0, 2: h_fw[0], 3: h_fw[1], 4: h_fw[2],
+               6: h_bfp_out, 7: h_fw[4], 8: h_fw[5]}
+
+    # ---- FWH residuals: energy balance + UA-LMTD ----------------------
+    res = []
+    scale_q = 1e-7
+    for i in FWH_LIST:
+        k = SPLIT_OF_FWH[i]
+        steam_flow, h_steam, P_sh, T_steam = ext[k]
+        e_i = e_fwh[i]
+        if i in (7, 6):
+            dr_in, h_dr = drain_hp[i + 1], hf[i + 1]
+        elif i in (3, 2, 1):
+            dr_in, h_dr = drain_lp[i + 1], hf[i + 1]
+        else:  # fwh8 (topmost) and fwh4 (LP top) get no cascaded drain
+            dr_in, h_dr = 0.0, 0.0
+        shell_flow = e_i + dr_in
+        h_shell_in = (e_i * h_steam + dr_in * h_dr) / jnp.maximum(shell_flow, 1e-9)
+        T_shell_in = st.temperature_ph(P_sh, h_shell_in)
+        q_shell = shell_flow * (h_shell_in - hf[i])
+        j = POS_OF_FWH[i]
+        q_tube = tube_flow[i] * (h_fw[j] - h_in_fw[i])
+        res.append(scale_q * (q_shell - q_tube))
+        T_fw_out = st.temperature_ph_liquid(P_fw_out[j], h_fw[j])
+        T_fw_in = st.temperature_ph_liquid(P_fw_in[j], h_in_fw[i])
+        lmtd = _lmtd_underwood(T_shell_in - T_fw_out, T_drain[i] - T_fw_in)
+        res.append(scale_q * (FWH_U[i] * FWH_AREA[i] * lmtd - q_tube))
+
+    # ---- BFPT drives the BFP only (`:372-377`) ------------------------
+    bx = st.turbine_expansion_ph(ext[4][2], ext[4][1], P_cond, BFPT_EFF)
+    w_bfpt = e_bfpt * bx.work
+    res.append(scale_q * (w_bfpt - w_bfp))
+
+    net_W = W - w_cond_pump  # `:387-399`: condensate pump is motor-driven
+    return (
+        jnp.stack([jnp.asarray(rr) for rr in res]),
+        (net_W, Q_rh, h_fw, mflow, h_boiler_out),
+    )
+
+
+def _residual_fn(x, params):
+    return _cycle_residuals(x, params)[0]
+
+
+def solve_scpc_cycle(
+    P_main: float = MAIN_STEAM_P,
+    flow_mol: float = MAIN_FLOW_MOL,
+    tol: float = 1e-9,
+    max_iter: int = 60,
+) -> SCPCResult:
+    """Solve the SCPC cycle square system at given throttle (P, flow)."""
+    params = {
+        "P_main": jnp.asarray(P_main, jnp.result_type(float)),
+        "flow_mol": jnp.asarray(flow_mol, jnp.result_type(float)),
+    }
+    x0 = jnp.concatenate(
+        [
+            jnp.asarray(INIT_FRACS),
+            jnp.asarray([INIT_BFPT]),
+            jnp.linspace(0.2, 1.2, 7),
+        ]
+    ).astype(jnp.result_type(float))
+    sol = solve_square(_residual_fn, x0, params=params, tol=tol, max_iter=max_iter)
+    _, (W, Q_rh, h_fw, mflow, h_boiler_out) = _cycle_residuals(sol.x, params)
+    q_boiler = mflow * (h_boiler_out - h_fw[POS_OF_FWH[8]])
+    return SCPCResult(
+        power_mw=W / 1e6,
+        heat_duty_mw=(q_boiler + Q_rh) / 1e6,
+        boiler_flow_mol=params["flow_mol"],
+        fracs=sol.x[:7],
+        bfpt_frac=sol.x[7],
+        h_fw=h_fw,
+        residual=sol.kkt_error,
+    )

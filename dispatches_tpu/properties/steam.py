@@ -323,12 +323,10 @@ class ExpansionResult(NamedTuple):
     work: jnp.ndarray  # J/kg extracted (positive)
 
 
-def turbine_expansion(P_in, T_in, P_out, eta_isentropic=1.0) -> ExpansionResult:
-    """Expand superheated steam from (P_in, T_in) to P_out with isentropic
-    efficiency eta. Handles wet exhaust via region-4 quality mixing — the
-    IDAES HelmTurbineStage behavior (`simple_rankine_cycle.py:110-130`)."""
-    inlet = props_vapor(P_in, T_in)
-    s_in = inlet.s
+def _expand_from_state(h_in, s_in, P_out, eta_isentropic) -> ExpansionResult:
+    """Shared expansion endpoint: isentropic target at P_out (wet via
+    region-4 quality mixing, dry via the (P, s) inversion), efficiency
+    blend, and the actual outlet state."""
     Tsat = sat_temperature(P_out)
     liq = props_liquid(P_out, Tsat)
     vap = props_vapor(P_out, Tsat)
@@ -340,7 +338,7 @@ def turbine_expansion(P_in, T_in, P_out, eta_isentropic=1.0) -> ExpansionResult:
     h_s_dry = props_vapor(P_out, T_dry).h
     h_s = jnp.where(wet, h_s_wet, h_s_dry)
 
-    h_out = inlet.h - eta_isentropic * (inlet.h - h_s)
+    h_out = h_in - eta_isentropic * (h_in - h_s)
     # actual endpoint state at P_out
     wet_act = h_out < vap.h
     x = jnp.clip((h_out - liq.h) / jnp.maximum(vap.h - liq.h, 1e-9), 0.0, 1.0)
@@ -351,8 +349,36 @@ def turbine_expansion(P_in, T_in, P_out, eta_isentropic=1.0) -> ExpansionResult:
         h_out=h_out,
         T_out=T_out,
         quality=jnp.where(wet_act, x, jnp.ones_like(x)),
-        work=inlet.h - h_out,
+        work=h_in - h_out,
     )
+
+
+def turbine_expansion(P_in, T_in, P_out, eta_isentropic=1.0) -> ExpansionResult:
+    """Expand superheated steam from (P_in, T_in) to P_out with isentropic
+    efficiency eta. Handles wet exhaust via region-4 quality mixing — the
+    IDAES HelmTurbineStage behavior (`simple_rankine_cycle.py:110-130`)."""
+    inlet = props_vapor(P_in, T_in)
+    return _expand_from_state(inlet.h, inlet.s, P_out, eta_isentropic)
+
+
+def turbine_expansion_ph(P_in, h_in, P_out, eta_isentropic=1.0) -> ExpansionResult:
+    """Expand steam given the TRUE inlet enthalpy (possibly two-phase) —
+    the (P, h) form of :func:`turbine_expansion`. Required for multi-stage
+    trains whose later stages ingest wet steam: the (P, T) form cannot
+    represent a wet inlet (T pins to Tsat and the state collapses to dry
+    saturated vapor, overstating the inlet enthalpy)."""
+    Tsat_in = sat_temperature(P_in)
+    liq_i = props_liquid(P_in, Tsat_in)
+    vap_i = props_vapor(P_in, Tsat_in)
+    wet_in = h_in < vap_i.h
+    x_in = jnp.clip(
+        (h_in - liq_i.h) / jnp.maximum(vap_i.h - liq_i.h, 1e-9), 0.0, 1.0
+    )
+    s_wet = liq_i.s + x_in * (vap_i.s - liq_i.s)
+    T_dry_in = temperature_ph_vapor(P_in, h_in, T_guess=Tsat_in + 10.0)
+    s_dry = props_vapor(P_in, T_dry_in).s
+    s_in = jnp.where(wet_in, s_wet, s_dry)
+    return _expand_from_state(h_in, s_in, P_out, eta_isentropic)
 
 
 def pump_work(P_in, P_out, T_in, eta_isentropic=1.0):
