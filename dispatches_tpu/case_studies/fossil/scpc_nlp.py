@@ -35,9 +35,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...properties import steam as st
+from ...properties.steam import MW_H2O
 from ...solvers.nlp import solve_square
-
-MW_H2O = 0.01801528  # kg/mol
 
 # ---- reference data (`fix_dof_and_initialize`, `:622-698`) ---------------
 MAIN_FLOW_MOL = 29111.0
@@ -73,6 +72,10 @@ INIT_FRACS = np.array(
 )  # splitter order: s1(fwh8) s2 s3 s5(fwh4) s6 s7 s8
 INIT_BFPT = 1.0 - 0.9019 - DEA_SPLIT  # splitter 4 remainder (`:715`)
 
+# concrete-TES initial wall profile (`CONC_TES_DATA`, `:87-91`): linear
+# 750 K -> 420 K across the 20 segments
+TES_INIT_TEMPERATURE = np.linspace(750.0, 420.0, 20)
+
 
 class SCPCResult(NamedTuple):
     power_mw: jnp.ndarray  # net: Σ turbine work - condensate-pump work
@@ -84,11 +87,7 @@ class SCPCResult(NamedTuple):
     residual: jnp.ndarray
 
 
-def _lmtd_underwood(dt1, dt2):
-    a = jnp.maximum(dt1, 1e-2) ** (1.0 / 3.0)
-    b = jnp.maximum(dt2, 1e-2) ** (1.0 / 3.0)
-    return (0.5 * (a + b)) ** 3
-
+_lmtd_underwood = st.lmtd_underwood
 
 # index of each FWH in the h_fw / tube-pressure vectors (fwh1..4, 6..8)
 FWH_LIST = (1, 2, 3, 4, 6, 7, 8)
@@ -101,6 +100,12 @@ def _cycle_residuals(x, params):
     P_main = params["P_main"]
     flow_mol = params["flow_mol"]
     mflow = flow_mol * MW_H2O
+    # optional concrete-TES charge loop (`include_concrete_tes`): hp
+    # splitter diverts `tes_split` of the main steam before turbine 1
+    # (`:418`), and the TES condensate returns to fwh_mix[7] (`:420`)
+    tes_split = params.get("tes_split", 0.0)
+    h_tes = params.get("h_tes", 0.0)  # TES charge-outlet enthalpy [J/kg]
+    m_tes = mflow * tes_split
 
     fracs = x[:7]
     f_bfpt = x[7]
@@ -113,7 +118,7 @@ def _cycle_residuals(x, params):
     P_in = P_main
     h_in = st.props_vapor(P_in, MAIN_STEAM_T).h
     T_in = MAIN_STEAM_T
-    flow = mflow
+    flow = mflow * (1.0 - tes_split)
     W = 0.0
     Q_rh = 0.0
     ext = {}
@@ -154,22 +159,25 @@ def _cycle_residuals(x, params):
     e_bfpt = ext[4][0] * f_bfpt
     # condensate flow through fwh1..4 = everything reaching the condenser:
     # stage-9 exhaust + LP drains + BFPT exhaust (`:563`, bfpt -> condenser
-    # mix) — only the HP extractions and deaerator steam bypass it
-    cond_flow = mflow - (e_fwh[8] + e_fwh[7] + e_fwh[6] + e_dea)
+    # mix) — the HP extractions, deaerator steam, and TES condensate
+    # (returning via the fwh7 drain cascade) bypass it
+    cond_flow = mflow - (e_fwh[8] + e_fwh[7] + e_fwh[6] + e_dea + m_tes)
     tube_flow = {1: cond_flow, 2: cond_flow, 3: cond_flow, 4: cond_flow,
                  6: mflow, 7: mflow, 8: mflow}
 
     # ---- drain states: saturated liquid at 1.1 * ratio * P_extraction --
+    # (one saturation inversion per FWH; this sits under jacfwd + Newton)
     P_drain = {
         i: 1.1 * FWH_DRAIN_RATIO[i] * ext[SPLIT_OF_FWH[i]][2] for i in FWH_LIST
     }
-    hf = {i: st.sat_liquid(P_drain[i]).h for i in FWH_LIST}
     T_drain = {i: st.sat_temperature(P_drain[i]) for i in FWH_LIST}
+    hf = {i: st.props_liquid(P_drain[i], T_drain[i]).h for i in FWH_LIST}
 
-    # drain cascades (`:536`): HP 8->7->6->deaerator, LP 4->3->2->1->cond
+    # drain cascades (`:536`): HP 8->7->6->deaerator, LP 4->3->2->1->cond;
+    # the TES condensate enters at fwh_mix[7] (`:420`)
     drain_hp = {8: e_fwh[8]}
-    for i in (7, 6):
-        drain_hp[i] = drain_hp[i + 1] + e_fwh[i]
+    drain_hp[7] = drain_hp[8] + e_fwh[7] + m_tes
+    drain_hp[6] = drain_hp[7] + e_fwh[6]
     drain_lp = {4: e_fwh[4]}
     for i in (3, 2, 1):
         drain_lp[i] = drain_lp[i + 1] + e_fwh[i]
@@ -200,14 +208,19 @@ def _cycle_residuals(x, params):
         k = SPLIT_OF_FWH[i]
         steam_flow, h_steam, P_sh, T_steam = ext[k]
         e_i = e_fwh[i]
-        if i in (7, 6):
-            dr_in, h_dr = drain_hp[i + 1], hf[i + 1]
+        if i == 7:  # fwh8 drain + the TES condensate (`fwh_mix[7]`, `:420`)
+            dr_in = drain_hp[8] + m_tes
+            h_dr_flow = drain_hp[8] * hf[8] + m_tes * h_tes
+        elif i == 6:
+            dr_in = drain_hp[7]
+            h_dr_flow = dr_in * hf[7]
         elif i in (3, 2, 1):
-            dr_in, h_dr = drain_lp[i + 1], hf[i + 1]
+            dr_in = drain_lp[i + 1]
+            h_dr_flow = dr_in * hf[i + 1]
         else:  # fwh8 (topmost) and fwh4 (LP top) get no cascaded drain
-            dr_in, h_dr = 0.0, 0.0
+            dr_in, h_dr_flow = 0.0, 0.0
         shell_flow = e_i + dr_in
-        h_shell_in = (e_i * h_steam + dr_in * h_dr) / jnp.maximum(shell_flow, 1e-9)
+        h_shell_in = (e_i * h_steam + h_dr_flow) / jnp.maximum(shell_flow, 1e-9)
         T_shell_in = st.temperature_ph(P_sh, h_shell_in)
         q_shell = shell_flow * (h_shell_in - hf[i])
         j = POS_OF_FWH[i]
@@ -239,11 +252,17 @@ def solve_scpc_cycle(
     flow_mol: float = MAIN_FLOW_MOL,
     tol: float = 1e-9,
     max_iter: int = 60,
+    tes_split: float = 0.0,
+    h_tes: float = 0.0,
 ) -> SCPCResult:
-    """Solve the SCPC cycle square system at given throttle (P, flow)."""
+    """Solve the SCPC cycle square system at given throttle (P, flow).
+    `tes_split`/`h_tes` couple a charge-mode thermal store (fraction of
+    main steam diverted before turbine 1; its condensate enthalpy)."""
     params = {
         "P_main": jnp.asarray(P_main, jnp.result_type(float)),
         "flow_mol": jnp.asarray(flow_mol, jnp.result_type(float)),
+        "tes_split": jnp.asarray(tes_split, jnp.result_type(float)),
+        "h_tes": jnp.asarray(h_tes, jnp.result_type(float)),
     }
     x0 = jnp.concatenate(
         [
@@ -264,3 +283,38 @@ def solve_scpc_cycle(
         h_fw=h_fw,
         residual=sol.kkt_error,
     )
+
+
+def solve_scpc_with_tes(
+    hp_split_fraction: float = 0.1,
+    discharge_flow_mol: float = 1.0,
+    P_main: float = MAIN_STEAM_P,
+    flow_mol: float = MAIN_FLOW_MOL,
+    **kw,
+):
+    """SCPC cycle with the concrete-TES charge loop (the reference's
+    `include_concrete_tes=True` configuration, golden 625 MW ± 1,
+    `test_scpc_flowsheet.py:71`): `hp_split_fraction` of the main steam
+    charges the store (`CONC_TES_DATA`, `:78-99`); its condensate returns
+    to fwh_mix[7]. Returns (SCPCResult, TESHourResult)."""
+    from ...units.concrete_tes import ConcreteTES, TESDesign, stream_from_pt
+
+    charge = stream_from_pt(
+        flow_mol * hp_split_fraction, P_main, MAIN_STEAM_T
+    )
+    discharge = stream_from_pt(discharge_flow_mol, 8.5e5, 355.0)
+    design = TESDesign()
+    tes = ConcreteTES(design, mode="combined").hour(
+        jnp.asarray(TES_INIT_TEMPERATURE, jnp.result_type(float)),
+        charge=charge,
+        discharge=discharge,
+    )
+    h_tes = tes.outlet_charge.enth_mol / MW_H2O  # J/mol -> J/kg
+    res = solve_scpc_cycle(
+        P_main=P_main,
+        flow_mol=flow_mol,
+        tes_split=hp_split_fraction,
+        h_tes=float(np.asarray(h_tes)),
+        **kw,
+    )
+    return res, tes

@@ -34,9 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from ...properties import steam as st
+from ...properties.steam import MW_H2O
 from ...solvers.nlp import solve_square
-
-MW_H2O = 0.01801528  # kg/mol
 
 # ---- reference data (`set_model_input`, `:714-805`) ----------------------
 MAIN_FLOW_MOL = 17854.0
@@ -91,12 +90,8 @@ class CycleResult(NamedTuple):
     residual: jnp.ndarray
 
 
-def _lmtd_underwood(dt1, dt2):
-    """Underwood approximation (the reference's delta-T callback,
-    `:180`): ((dt1^(1/3) + dt2^(1/3)) / 2)^3, smooth-clipped positive."""
-    a = jnp.maximum(dt1, 1e-2) ** (1.0 / 3.0)
-    b = jnp.maximum(dt2, 1e-2) ** (1.0 / 3.0)
-    return (0.5 * (a + b)) ** 3
+# Underwood approximation (the reference's delta-T callback, `:180`)
+_lmtd_underwood = st.lmtd_underwood
 
 
 def _cycle_residuals(x, params):

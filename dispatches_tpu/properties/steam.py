@@ -385,3 +385,14 @@ def pump_work(P_in, P_out, T_in, eta_isentropic=1.0):
     """Feedwater pump specific work [J/kg]: v dP / eta (incompressible)."""
     v = props_liquid(P_in, T_in).v
     return v * (jnp.asarray(P_out) - jnp.asarray(P_in)) / eta_isentropic
+
+
+MW_H2O = 0.01801528  # kg/mol
+
+
+def lmtd_underwood(dt1, dt2):
+    """Underwood LMTD approximation (the reference FWH delta-T callback):
+    ((dt1^(1/3) + dt2^(1/3)) / 2)^3, smooth-clipped positive."""
+    a = jnp.maximum(dt1, 1e-2) ** (1.0 / 3.0)
+    b = jnp.maximum(dt2, 1e-2) ** (1.0 / 3.0)
+    return (0.5 * (a + b)) ** 3

@@ -14,12 +14,23 @@ from dispatches_tpu.case_studies.fossil.scpc_nlp import (
     DEA_SPLIT,
     MAIN_FLOW_MOL,
     solve_scpc_cycle,
+    solve_scpc_with_tes,
 )
 from dispatches_tpu.properties import steam as st
 
 
-def test_design_net_power_golden():
-    s = solve_scpc_cycle()
+@pytest.fixture(scope="module")
+def design_solution():
+    return solve_scpc_cycle()
+
+
+@pytest.fixture(scope="module")
+def tes_solution():
+    return solve_scpc_with_tes()
+
+
+def test_design_net_power_golden(design_solution):
+    s = design_solution
     assert float(np.asarray(s.residual)) < 1e-8
     # the reference's own tolerance (`test_scpc_flowsheet.py:52`)
     assert float(np.asarray(s.power_mw)) == pytest.approx(692.0, abs=1.0)
@@ -28,10 +39,32 @@ def test_design_net_power_golden():
     assert 0.42 < eff < 0.48
 
 
-def test_extraction_fractions_near_reference_solution():
+def test_with_concrete_tes_golden(tes_solution):
+    """The reference's TES-charging configuration
+    (`test_scpc_flowsheet.py:71`): 10% of main steam diverted to the
+    concrete store, condensate returning to fwh_mix[7] — net power
+    625 MW ± 1. Exercises the ConcreteTES unit at an operating point far
+    from its own unit-test fixture (24.2 MPa supercritical charge)."""
+    res, tes = tes_solution
+    assert float(np.asarray(res.residual)) < 1e-8
+    assert float(np.asarray(res.power_mw)) == pytest.approx(625.0, abs=1.0)
+    # the store is actually absorbing heat: condensate leaves far below
+    # the main-steam enthalpy
+    assert float(np.asarray(tes.outlet_charge.enth_mol)) < 30000.0
+
+
+def test_tes_charging_power_drop(design_solution, tes_solution):
+    res, _ = tes_solution
+    drop = float(np.asarray(design_solution.power_mw)) - float(
+        np.asarray(res.power_mw)
+    )
+    assert 55.0 < drop < 80.0  # charging costs ~66 MW of output
+
+
+def test_extraction_fractions_near_reference_solution(design_solution):
     """The solved splitter fractions track the reference's converged-state
     estimates (`fix_dof_and_initialize:717-724`)."""
-    s = solve_scpc_cycle()
+    s = design_solution
     fr = np.asarray(s.fracs)
     ref = np.array([0.12812, 0.061824, 0.03815, 0.0381443, 0.017535, 0.0154])
     # splitter order s1(fwh8) s2 s3 s5(fwh4) s6 s7 — s8 is ~1e-3 noise-level
