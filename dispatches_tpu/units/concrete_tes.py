@@ -34,7 +34,7 @@ import jax.numpy as jnp
 
 from ..properties import steam
 
-M_WATER = 18.01528e-3  # kg/mol
+from ..properties.steam import MW_H2O as M_WATER  # kg/mol
 
 
 def u_tes(r, k, a, b, xp=jnp):

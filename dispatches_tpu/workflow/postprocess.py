@@ -132,3 +132,105 @@ def calculate_npv(
         "annual_fixed_om": float(fixed_om),
         "annualized_revenue": float(annual_revenue),
     }
+
+
+# ------------------------------------------------------ real-Prescient CSVs
+def read_prescient_datetime_csv(path: str) -> Dict[str, np.ndarray]:
+    """One Prescient output CSV (`bus_detail.csv`, `thermal_detail.csv`,
+    `renewables_detail.csv`, `hourly_summary.csv`, ...) -> column arrays
+    keyed by header, plus a "Datetime" key of ISO strings assembled from
+    the Date/Hour[/Minute] columns (`double_loop_utils.py:21-33`
+    behavior). Numeric columns parse to float arrays; labels stay str."""
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    if not rows:
+        return {}
+    out: Dict[str, np.ndarray] = {}
+    have_minute = "Minute" in rows[0]
+    dts = []
+    for r in rows:
+        minute = int(float(r.get("Minute", 0) or 0)) if have_minute else 0
+        dts.append(f"{r['Date']} {int(float(r['Hour'])):02d}:{minute:02d}")
+    out["Datetime"] = np.asarray(dts)
+    for key in rows[0]:
+        if key in ("Date", "Hour", "Minute"):
+            continue
+        vals = [r[key] for r in rows]
+        # label columns stay strings even when their values look numeric
+        # (datasets with numeric bus/generator ids must still match by
+        # string equality downstream)
+        if key in ("Generator", "Bus"):
+            out[key] = np.asarray(vals)
+            continue
+        try:
+            out[key] = np.asarray([float(v or 0.0) for v in vals])
+        except ValueError:
+            out[key] = np.asarray(vals)
+    return out
+
+
+def read_prescient_output_dir(
+    output_dir: str,
+    gen_name: str,
+    bus: Optional[str] = None,
+) -> Dict[str, np.ndarray]:
+    """Hourly series for ONE generator from a real Prescient output
+    directory (the task of `prescient_outputs_for_gen`,
+    `double_loop_utils.py:176-206`): generator dispatch/revenue columns
+    from thermal_detail.csv + renewables_detail.csv (whichever carries the
+    generator — the double loop may register a wind plant as thermal),
+    merged with its bus's LMP series from bus_detail.csv on Datetime.
+
+    `bus` may be omitted only when bus_detail.csv has a single bus; with
+    several buses an explicit (existing) name is required — guessing the
+    bus would silently price the generator at the wrong node."""
+    import os
+
+    if gen_name is None:
+        raise ValueError("gen_name is required (one generator per call)")
+    gen_cols: Dict[str, np.ndarray] = {}
+    for fname in ("thermal_detail.csv", "renewables_detail.csv"):
+        p = os.path.join(output_dir, fname)
+        if not os.path.exists(p):
+            continue
+        tab = read_prescient_datetime_csv(p)
+        if not tab or "Generator" not in tab:
+            continue
+        mask = tab["Generator"] == gen_name
+        if not mask.any():
+            continue
+        tab = {k: v[mask] for k, v in tab.items()}
+        gen_cols = {**tab, **gen_cols}  # thermal fields win on overlap
+    if not gen_cols:
+        raise FileNotFoundError(
+            f"generator {gen_name!r} not found in thermal/renewables detail "
+            f"under {output_dir}"
+        )
+
+    bus_p = os.path.join(output_dir, "bus_detail.csv")
+    if os.path.exists(bus_p):
+        bt = read_prescient_datetime_csv(bus_p)
+        buses = np.unique(bt["Bus"]) if "Bus" in bt else np.zeros(0)
+        if bus is None:
+            if len(buses) > 1:
+                raise ValueError(
+                    f"bus_detail.csv has {len(buses)} buses "
+                    f"({', '.join(map(str, buses))}); pass bus= explicitly"
+                )
+        elif "Bus" in bt:
+            mask = bt["Bus"] == bus
+            if not mask.any():
+                raise ValueError(
+                    f"bus {bus!r} not in bus_detail.csv "
+                    f"(buses: {', '.join(map(str, buses))})"
+                )
+            bt = {k: v[mask] for k, v in bt.items()}
+        lmp_of_dt = dict(zip(bt["Datetime"], bt.get("LMP", np.zeros(0))))
+        lmp_da_of_dt = dict(zip(bt["Datetime"], bt.get("LMP DA", np.zeros(0))))
+        gen_cols["LMP"] = np.asarray(
+            [float(lmp_of_dt.get(d, 0.0)) for d in gen_cols["Datetime"]]
+        )
+        gen_cols["LMP DA"] = np.asarray(
+            [float(lmp_da_of_dt.get(d, 0.0)) for d in gen_cols["Datetime"]]
+        )
+    return gen_cols

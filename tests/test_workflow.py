@@ -214,3 +214,93 @@ def test_pricetaker_results_carry_solver_stats():
     st = res["solver_stats"]
     assert st["converged_frac"] == 1.0
     assert st["iterations"]["max"] >= 1
+
+
+class TestPrescientOutputReaders:
+    """Readers for REAL Prescient output directories (the
+    `double_loop_utils.py:176-206` task), driven by a synthesized output
+    dir in the standard schema."""
+
+    @pytest.fixture
+    def output_dir(self, tmp_path):
+        import csv as _csv
+
+        def write(name, header, rows):
+            with open(tmp_path / name, "w", newline="") as f:
+                w = _csv.writer(f)
+                w.writerow(header)
+                w.writerows(rows)
+
+        write(
+            "renewables_detail.csv",
+            ["Date", "Hour", "Generator", "Output", "Output DA", "Curtailment",
+             "Unit Market Revenue", "Unit Uplift Payment"],
+            [["2020-07-10", h, "303_WIND_1", 80 + h, 75 + h, 0.5 * h, 100.0, 0.0]
+             for h in range(4)]
+            + [["2020-07-10", h, "122_PV_1", 10, 11, 0, 5.0, 0.0] for h in range(4)],
+        )
+        write(
+            "thermal_detail.csv",
+            ["Date", "Hour", "Generator", "Dispatch", "Dispatch DA",
+             "Unit Market Revenue", "Unit Uplift Payment"],
+            [["2020-07-10", h, "102_STEAM_3", 55.0, 54.0, 900.0, 0.0]
+             for h in range(4)],
+        )
+        write(
+            "bus_detail.csv",
+            ["Date", "Hour", "Bus", "LMP", "LMP DA", "Demand", "Shortfall"],
+            [["2020-07-10", h, "Caesar", 20.0 + h, 19.0 + h, 300.0, 0.0]
+             for h in range(4)]
+            + [["2020-07-10", h, "Bach", 99.0, 98.0, 100.0, 0.0] for h in range(4)],
+        )
+        return tmp_path
+
+    def test_datetime_assembly_and_dtypes(self, output_dir):
+        from dispatches_tpu.workflow.postprocess import read_prescient_datetime_csv
+
+        tab = read_prescient_datetime_csv(str(output_dir / "bus_detail.csv"))
+        assert tab["Datetime"][0] == "2020-07-10 00:00"
+        assert tab["LMP"].dtype.kind == "f"
+        assert tab["Bus"].dtype.kind in ("U", "S")
+
+    def test_outputs_for_renewable_gen(self, output_dir):
+        from dispatches_tpu.workflow.postprocess import read_prescient_output_dir
+
+        d = read_prescient_output_dir(
+            str(output_dir), gen_name="303_WIND_1", bus="Caesar"
+        )
+        np.testing.assert_allclose(d["Output"], [80, 81, 82, 83])
+        np.testing.assert_allclose(d["LMP"], [20, 21, 22, 23])
+        np.testing.assert_allclose(d["LMP DA"], [19, 20, 21, 22])
+        assert (d["Generator"] == "303_WIND_1").all()
+
+    def test_outputs_for_thermal_gen(self, output_dir):
+        from dispatches_tpu.workflow.postprocess import read_prescient_output_dir
+
+        d = read_prescient_output_dir(
+            str(output_dir), gen_name="102_STEAM_3", bus="Bach"
+        )
+        np.testing.assert_allclose(d["Dispatch"], [55.0] * 4)
+        np.testing.assert_allclose(d["LMP"], [99.0] * 4)
+
+    def test_missing_gen_raises(self, output_dir):
+        from dispatches_tpu.workflow.postprocess import read_prescient_output_dir
+
+        with pytest.raises(FileNotFoundError, match="not found"):
+            read_prescient_output_dir(str(output_dir), gen_name="nope")
+
+    def test_ambiguous_bus_raises(self, output_dir):
+        """Two buses + no bus argument must refuse rather than silently
+        pricing the generator at whichever bus sorts last."""
+        from dispatches_tpu.workflow.postprocess import read_prescient_output_dir
+
+        with pytest.raises(ValueError, match="pass bus="):
+            read_prescient_output_dir(str(output_dir), gen_name="303_WIND_1")
+
+    def test_wrong_bus_raises(self, output_dir):
+        from dispatches_tpu.workflow.postprocess import read_prescient_output_dir
+
+        with pytest.raises(ValueError, match="not in bus_detail"):
+            read_prescient_output_dir(
+                str(output_dir), gen_name="303_WIND_1", bus="Ceasar"
+            )
