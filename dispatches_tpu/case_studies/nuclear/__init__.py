@@ -10,3 +10,4 @@ from .pricetaker import (
     run_price_taker,
     settlement_prices,
 )
+from . import conceptual_design

@@ -10,6 +10,7 @@ summaries come out as plain dicts/arrays.
 from __future__ import annotations
 
 import csv
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -183,12 +184,16 @@ def read_prescient_output_dir(
 
     `bus` may be omitted only when bus_detail.csv has a single bus; with
     several buses an explicit (existing) name is required — guessing the
-    bus would silently price the generator at the wrong node."""
-    import os
-
+    bus would silently price the generator at the wrong node. Every
+    lookup failure raises: a missing LMP column, a bus_detail timestamp
+    grid that doesn't cover the generator's hours, or a `bus` argument
+    the file cannot be filtered by."""
     if gen_name is None:
         raise ValueError("gen_name is required (one generator per call)")
     gen_cols: Dict[str, np.ndarray] = {}
+    # one source table per generator — a double-loop plant registered as
+    # thermal reads from thermal_detail only; mixing two tables filtered
+    # by different masks would misalign columns
     for fname in ("thermal_detail.csv", "renewables_detail.csv"):
         p = os.path.join(output_dir, fname)
         if not os.path.exists(p):
@@ -199,8 +204,8 @@ def read_prescient_output_dir(
         mask = tab["Generator"] == gen_name
         if not mask.any():
             continue
-        tab = {k: v[mask] for k, v in tab.items()}
-        gen_cols = {**tab, **gen_cols}  # thermal fields win on overlap
+        gen_cols = {k: v[mask] for k, v in tab.items()}
+        break
     if not gen_cols:
         raise FileNotFoundError(
             f"generator {gen_name!r} not found in thermal/renewables detail "
@@ -210,6 +215,10 @@ def read_prescient_output_dir(
     bus_p = os.path.join(output_dir, "bus_detail.csv")
     if os.path.exists(bus_p):
         bt = read_prescient_datetime_csv(bus_p)
+        if bus is not None and "Bus" not in bt:
+            raise ValueError(
+                "bus= was given but bus_detail.csv has no 'Bus' column"
+            )
         buses = np.unique(bt["Bus"]) if "Bus" in bt else np.zeros(0)
         if bus is None:
             if len(buses) > 1:
@@ -217,7 +226,7 @@ def read_prescient_output_dir(
                     f"bus_detail.csv has {len(buses)} buses "
                     f"({', '.join(map(str, buses))}); pass bus= explicitly"
                 )
-        elif "Bus" in bt:
+        else:
             mask = bt["Bus"] == bus
             if not mask.any():
                 raise ValueError(
@@ -225,12 +234,18 @@ def read_prescient_output_dir(
                     f"(buses: {', '.join(map(str, buses))})"
                 )
             bt = {k: v[mask] for k, v in bt.items()}
-        lmp_of_dt = dict(zip(bt["Datetime"], bt.get("LMP", np.zeros(0))))
-        lmp_da_of_dt = dict(zip(bt["Datetime"], bt.get("LMP DA", np.zeros(0))))
-        gen_cols["LMP"] = np.asarray(
-            [float(lmp_of_dt.get(d, 0.0)) for d in gen_cols["Datetime"]]
-        )
-        gen_cols["LMP DA"] = np.asarray(
-            [float(lmp_da_of_dt.get(d, 0.0)) for d in gen_cols["Datetime"]]
-        )
+        for col, key in (("LMP", "LMP"), ("LMP DA", "LMP DA")):
+            if col not in bt:
+                raise ValueError(f"bus_detail.csv has no {col!r} column")
+            of_dt = dict(zip(bt["Datetime"], bt[col]))
+            missing = [d for d in gen_cols["Datetime"] if d not in of_dt]
+            if missing:
+                raise ValueError(
+                    f"bus_detail.csv does not cover {len(missing)} of the "
+                    f"generator's timestamps (first: {missing[0]!r}) — "
+                    "mixed time resolutions?"
+                )
+            gen_cols[key] = np.asarray(
+                [float(of_dt[d]) for d in gen_cols["Datetime"]]
+            )
     return gen_cols

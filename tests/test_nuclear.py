@@ -189,3 +189,112 @@ def test_exhaustive_enumeration_batched():
     )
     assert out["pem_cap_factor"]["10"] == pytest.approx(1.0, abs=1e-3)
     assert out["pem_cap_factor"]["00"] == pytest.approx(0.0, abs=1e-3)
+
+
+class TestConceptualDesignNE:
+    """Surrogate-embedded PEM sizing for the nuclear case
+    (`nuclear_case/report/market_surrogates.py:106-260` analogue) with
+    analytic stand-in surrogates whose optimum is known in closed form."""
+
+    @staticmethod
+    def _surrogates():
+        """Revenue falls linearly as the PEM eats NPP output; capacity
+        factor falls linearly with the ratio. Input convention:
+        [threshold_price, ratio, reserve, max_lmp]."""
+
+        def revenue_fn(x):
+            return 2.0e8 * (1.0 - 0.8 * x[1])
+
+        def cf_fn(x):
+            return 0.98 - 0.5 * x[1]
+
+        return revenue_fn, cf_fn
+
+    def test_economics_identities(self):
+        from dispatches_tpu.case_studies.nuclear.conceptual_design import (
+            H2_PROD_RATE,
+            NP_CAPACITY,
+            NUM_HOURS,
+            ne_objective,
+        )
+
+        revenue_fn, cf_fn = self._surrogates()
+        obj, terms = ne_objective(0.25, 2.0, 10.0, 500.0, revenue_fn, cf_fn)
+        cf = float(terms["capacity_factor"])
+        assert cf == pytest.approx(0.98 - 0.5 * 0.25)
+        # net H2 = (1 - cf) * capacity * hours * production rate (`:190-200`)
+        assert float(terms["net_h2_production_kg"]) == pytest.approx(
+            (1 - cf) * NP_CAPACITY * NUM_HOURS * H2_PROD_RATE, rel=1e-9
+        )
+        assert float(terms["h2_revenue"]) == pytest.approx(
+            2.0 * float(terms["net_h2_production_kg"]), rel=1e-9
+        )
+
+    def test_optimum_matches_brute_force(self):
+        from dispatches_tpu.case_studies.nuclear.conceptual_design import (
+            RATIO_BOUNDS,
+            conceptual_design_ss_NE,
+            ne_objective,
+        )
+
+        revenue_fn, cf_fn = self._surrogates()
+        res = conceptual_design_ss_NE(revenue_fn, cf_fn, h2_price=2.0)
+        # dense brute force as the oracle
+        rs = np.linspace(*RATIO_BOUNDS, 20001)
+        vals = [
+            float(ne_objective(r, 2.0, 10.0, 500.0, revenue_fn, cf_fn)[0])
+            for r in rs[:: len(rs) // 400]
+        ]
+        r_star = rs[:: len(rs) // 400][int(np.argmin(vals))]
+        assert float(res.pem_np_cap_ratio) == pytest.approx(r_star, abs=2e-3)
+        assert float(res.objective) <= min(vals) + 1e3  # $ tolerance
+
+    def test_h2_price_monotonicity(self):
+        """Higher H2 prices must never shrink the optimal PEM (the
+        reference's enumeration story: H2 economics drive sizing)."""
+        from dispatches_tpu.case_studies.nuclear.conceptual_design import (
+            run_exhaustive_enumeration,
+        )
+
+        revenue_fn, cf_fn = self._surrogates()
+        out = run_exhaustive_enumeration(
+            revenue_fn, cf_fn, h2_prices=(0.75, 1.25, 1.75, 2.25)
+        )
+        ratios = out["best_ratio"]
+        assert (np.diff(ratios) >= -1e-9).all()
+        assert out["best_pem_mw"].shape == (4,)
+
+    def test_trained_surrogate_round_trip(self):
+        """End-to-end with REAL trained surrogates: fit tiny Flax MLPs to
+        the analytic maps, then design against the trained models."""
+        from dispatches_tpu.case_studies.nuclear.conceptual_design import (
+            conceptual_design_ss_NE,
+        )
+        from dispatches_tpu.surrogates.train import train_surrogate
+
+        rng = np.random.default_rng(0)
+        revenue_fn, cf_fn = self._surrogates()
+        X = np.column_stack(
+            [
+                rng.uniform(10, 50, 400),
+                rng.uniform(0.05, 0.5, 400),
+                np.full(400, 10.0),
+                np.full(400, 500.0),
+            ]
+        )
+        y_rev = np.array([float(revenue_fn(x)) for x in X])
+        y_cf = np.array([float(cf_fn(x)) for x in X])
+        sur_rev, met_r = train_surrogate(X, y_rev, hidden=(32, 32), epochs=300)
+        sur_cf, met_c = train_surrogate(X, y_cf, hidden=(32, 32), epochs=300)
+        assert float(np.min(met_r["R2"])) > 0.97
+        assert float(np.min(met_c["R2"])) > 0.97
+
+        res = conceptual_design_ss_NE(
+            lambda x: sur_rev.predict(x[None])[0],
+            lambda x: sur_cf.predict(x[None])[0],
+            h2_price=2.0,
+        )
+        exact = conceptual_design_ss_NE(revenue_fn, cf_fn, h2_price=2.0)
+        assert float(res.pem_np_cap_ratio) == pytest.approx(
+            float(exact.pem_np_cap_ratio), abs=0.05
+        )
