@@ -213,6 +213,10 @@ def read_prescient_output_dir(
         )
 
     bus_p = os.path.join(output_dir, "bus_detail.csv")
+    if bus is not None and not os.path.exists(bus_p):
+        raise FileNotFoundError(
+            f"bus= was given but {bus_p} does not exist — no LMPs to merge"
+        )
     if os.path.exists(bus_p):
         bt = read_prescient_datetime_csv(bus_p)
         if bus is not None and "Bus" not in bt:

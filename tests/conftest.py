@@ -6,6 +6,8 @@ Multi-chip sharding paths are validated on virtual CPU devices
 not in tests. The platform forcing itself is shared with the dryrun entry:
 `dispatches_tpu.parallel.mesh.force_virtual_cpu_mesh`.
 """
+import pytest
+
 import jax
 
 from dispatches_tpu.parallel.mesh import force_virtual_cpu_mesh
@@ -16,3 +18,15 @@ if not force_virtual_cpu_mesh(8):
         "CPU mesh — tests must not touch the TPU tunnel"
     )
 jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Free compiled executables between test modules. The full suite
+    compiles thousands of XLA programs in one process; letting them
+    accumulate has produced LLVM segfaults late in the run (observed in
+    `test_usc_nlp` at ~test 230 while compiling an unchanged function).
+    Per-module clearing bounds compiler-arena growth; within-module jit
+    reuse (the expensive case) is unaffected."""
+    yield
+    jax.clear_caches()

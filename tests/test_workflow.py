@@ -304,3 +304,14 @@ class TestPrescientOutputReaders:
             read_prescient_output_dir(
                 str(output_dir), gen_name="303_WIND_1", bus="Ceasar"
             )
+
+    def test_bus_arg_without_bus_detail_raises(self, output_dir):
+        import os
+
+        from dispatches_tpu.workflow.postprocess import read_prescient_output_dir
+
+        os.remove(output_dir / "bus_detail.csv")
+        with pytest.raises(FileNotFoundError, match="no LMPs to merge"):
+            read_prescient_output_dir(
+                str(output_dir), gen_name="303_WIND_1", bus="Caesar"
+            )
