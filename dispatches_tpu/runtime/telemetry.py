@@ -123,6 +123,13 @@ def batch_stats(sol) -> dict:
             "p90": float(np.quantile(v, 0.9)),
             "max": float(v.max()),
         }
+    if hasattr(sol, "status"):
+        from ..solvers.ipm import status_name
+
+        codes = np.atleast_1d(np.asarray(sol.status))
+        stats["status"] = {
+            status_name(c): int((codes == c).sum()) for c in np.unique(codes)
+        }
     return stats
 
 

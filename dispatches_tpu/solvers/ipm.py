@@ -35,6 +35,27 @@ import os as _os
 _MATMUL_PRECISION = _os.environ.get("DISPATCHES_TPU_MATMUL_PRECISION", "highest")
 
 
+# termination diagnosis (the analogue of a host solver's termination
+# condition, e.g. Pyomo's `results.solver.termination_condition` from
+# IPOPT/CBC): infeasibility/unboundedness SUSPICIONS from the residual
+# signature at exit — a stuck primal residual with clean dual feasibility
+# is the Farkas fingerprint, and vice versa. Heuristic, not a certificate.
+STATUS_OPTIMAL = 0
+STATUS_STALLED = 1  # hit max_iter / numerical breakdown, no diagnosis
+STATUS_PRIMAL_INFEASIBLE = 2  # suspected: constraints inconsistent
+STATUS_DUAL_INFEASIBLE = 3  # suspected: objective unbounded below
+_STATUS_NAMES = {
+    STATUS_OPTIMAL: "optimal",
+    STATUS_STALLED: "stalled",
+    STATUS_PRIMAL_INFEASIBLE: "primal_infeasible",
+    STATUS_DUAL_INFEASIBLE: "dual_infeasible",
+}
+
+
+def status_name(code) -> str:
+    return _STATUS_NAMES[int(code)]
+
+
 class IPMSolution(NamedTuple):
     x: jnp.ndarray
     y: jnp.ndarray  # equality duals
@@ -46,6 +67,7 @@ class IPMSolution(NamedTuple):
     res_primal: jnp.ndarray
     res_dual: jnp.ndarray
     gap: jnp.ndarray
+    status: jnp.ndarray  # STATUS_* code (see status_name)
 
 
 def _max_step(v, dv, mask):
@@ -156,6 +178,7 @@ def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q):
         res_primal=sol.res_primal,
         res_dual=sol.res_dual,
         gap=sol.gap,
+        status=sol.status,
     )
 
 
@@ -358,12 +381,13 @@ def _solve_scaled(
     rp, rd, comp = residuals(x, y, zl, zu)
     # report convergence from actual final residuals (the loop's `done` flag
     # may also fire on the numerical-breakdown guard); accept a modestly
-    # looser threshold than `tol` since breakdown can stop us a hair early
-    conv = (
-        (jnp.linalg.norm(rp) / bnorm < 100 * tol)
-        & (jnp.linalg.norm(rd) / cnorm < 100 * tol)
-        & (comp / (1.0 + jnp.abs(c @ x)) < 100 * tol)
-    )
+    # looser threshold than `tol` since breakdown can stop us a hair early.
+    # The SAME relative residuals feed the convergence test, the reported
+    # fields, and the status classification — one definition, three uses.
+    rp_rel = jnp.linalg.norm(rp) / bnorm
+    rd_rel = jnp.linalg.norm(rd) / cnorm
+    gap_rel = comp / (1.0 + jnp.abs(c @ x))
+    conv = (rp_rel < 100 * tol) & (rd_rel < 100 * tol) & (gap_rel < 100 * tol)
     return IPMSolution(
         x=x,
         y=y,
@@ -372,9 +396,31 @@ def _solve_scaled(
         obj=c @ x + c0,
         converged=conv,
         iterations=it,
-        res_primal=jnp.linalg.norm(rp) / bnorm,
-        res_dual=jnp.linalg.norm(rd) / cnorm,
-        gap=comp / (1.0 + jnp.abs(c @ x)),
+        res_primal=rp_rel,
+        res_dual=rd_rel,
+        gap=gap_rel,
+        status=_classify_exit(conv, rp_rel, rd_rel),
+    )
+
+
+def _classify_exit(conv, rp_rel, rd_rel):
+    """Termination diagnosis from the exit residual signature (measured on
+    the Ruiz+norm-scaled problem, so the data are O(1)): a primal residual
+    stuck far above tolerance is the primal-infeasibility fingerprint
+    (Farkas ray: duals can stay feasible while rp cannot shrink); a stuck
+    dual residual with clean primal feasibility and diverging |x| is the
+    unbounded fingerprint. 1e-3 separates these cleanly from near-converged
+    stalls (observed: infeasible/unbounded exits sit at rp or rd ~ 0.4-0.6;
+    genuine stalls sit below ~1e-5)."""
+    suspicious = 1e-3
+    return jnp.where(
+        conv,
+        STATUS_OPTIMAL,
+        jnp.where(
+            rp_rel > suspicious,
+            STATUS_PRIMAL_INFEASIBLE,
+            jnp.where(rd_rel > suspicious, STATUS_DUAL_INFEASIBLE, STATUS_STALLED),
+        ),
     )
 
 

@@ -777,6 +777,7 @@ def _solve_banded_jit(
         res_primal=sol.res_primal,
         res_dual=sol.res_dual,
         gap=sol.gap,
+        status=sol.status,
     )
 
 

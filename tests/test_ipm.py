@@ -79,3 +79,76 @@ def test_ipm_batch_vmap():
     for k in range(16):
         ref = scipy_solve(A, b, C[k], l, u)
         assert float(sol.obj[k]) == pytest.approx(ref.fun, rel=1e-6, abs=1e-6)
+
+
+class TestTerminationDiagnosis:
+    """Termination-condition parity with the reference's host solvers
+    (Pyomo surfaces IPOPT/CBC's infeasible/unbounded conditions; here the
+    exit residual signature provides the suspicion)."""
+
+    def test_optimal(self):
+        from dispatches_tpu.solvers.ipm import STATUS_OPTIMAL, status_name
+
+        lp = LPData(
+            A=jnp.asarray([[1.0, 1.0]]), b=jnp.asarray([1.0]),
+            c=jnp.asarray([1.0, 2.0]), l=jnp.zeros(2),
+            u=jnp.full(2, jnp.inf), c0=jnp.asarray(0.0),
+        )
+        s = solve_lp(lp, tol=1e-10)
+        assert int(s.status) == STATUS_OPTIMAL
+        assert status_name(s.status) == "optimal"
+
+    def test_primal_infeasible(self):
+        from dispatches_tpu.solvers.ipm import STATUS_PRIMAL_INFEASIBLE
+
+        # x1 + x2 = -1 with x >= 0: inconsistent
+        lp = LPData(
+            A=jnp.asarray([[1.0, 1.0]]), b=jnp.asarray([-1.0]),
+            c=jnp.asarray([1.0, 1.0]), l=jnp.zeros(2),
+            u=jnp.full(2, jnp.inf), c0=jnp.asarray(0.0),
+        )
+        s = solve_lp(lp, tol=1e-8, max_iter=60)
+        assert not bool(s.converged)
+        assert int(s.status) == STATUS_PRIMAL_INFEASIBLE
+
+    def test_conflicting_rows_primal_infeasible(self):
+        from dispatches_tpu.solvers.ipm import STATUS_PRIMAL_INFEASIBLE
+
+        # x1 = 1 and x1 = 2 simultaneously, x in [0, 1]
+        lp = LPData(
+            A=jnp.asarray([[1.0, 0.0], [1.0, 0.0]]),
+            b=jnp.asarray([1.0, 2.0]), c=jnp.asarray([1.0, 1.0]),
+            l=jnp.zeros(2), u=jnp.ones(2), c0=jnp.asarray(0.0),
+        )
+        s = solve_lp(lp, tol=1e-8, max_iter=60)
+        assert int(s.status) == STATUS_PRIMAL_INFEASIBLE
+
+    def test_dual_infeasible_unbounded(self):
+        from dispatches_tpu.solvers.ipm import STATUS_DUAL_INFEASIBLE
+
+        # min -x, x >= 0, unconstrained above: unbounded below
+        lp = LPData(
+            A=jnp.zeros((1, 1)), b=jnp.asarray([0.0]),
+            c=jnp.asarray([-1.0]), l=jnp.zeros(1),
+            u=jnp.full(1, jnp.inf), c0=jnp.asarray(0.0),
+        )
+        s = solve_lp(lp, tol=1e-8, max_iter=60)
+        assert int(s.status) == STATUS_DUAL_INFEASIBLE
+
+    def test_status_vmaps_over_batch(self):
+        from dispatches_tpu.solvers.ipm import (
+            STATUS_OPTIMAL,
+            STATUS_PRIMAL_INFEASIBLE,
+            solve_lp_batch,
+        )
+
+        # same A, one feasible RHS and one infeasible RHS
+        lp = LPData(
+            A=jnp.asarray([[1.0, 1.0]]),
+            b=jnp.asarray([[1.0], [-1.0]]),
+            c=jnp.asarray([1.0, 1.0]),
+            l=jnp.zeros(2), u=jnp.full(2, jnp.inf), c0=jnp.asarray(0.0),
+        )
+        s = solve_lp_batch(lp, tol=1e-8, max_iter=60)
+        assert int(s.status[0]) == STATUS_OPTIMAL
+        assert int(s.status[1]) == STATUS_PRIMAL_INFEASIBLE
