@@ -189,7 +189,7 @@ def _npv_objective(m: Model, units, design: HybridDesign, T: int, h2_price=None)
     if h2_rev is not None:
         profit = profit + h2_rev
 
-    # the 5-unit reference uses 52.143 weeks/yr, the others 52
+    # the 5-unit reference uses 52.143 weeks/yr in the NPV, the others 52
     weeks_per_year = 52.143 if "tank" in units else 52.0
     annual = (weeks_per_year / n_weeks) * profit.sum()
 
@@ -209,11 +209,17 @@ def _npv_objective(m: Model, units, design: HybridDesign, T: int, h2_price=None)
 
     npv = P.PA * annual - capex
     m.expression("annual_revenue", annual)
+    # reported revenue streams use the reference's 52-weeks/yr reporting
+    # convention in ALL cases (`wind_battery_PEM_tank_turbine_LMP.py:514-515`
+    # reports at 52 even though its NPV annualizes at 52.143); for the tank
+    # case "annual_rev_E" is the reference's elec *income* = sum of profit
+    # excluding H2 revenue (`:479,515`), elsewhere it is pure elec revenue
     if h2_rev is not None:
-        m.expression("annual_rev_h2", (weeks_per_year / n_weeks) * h2_rev.sum())
-    m.expression(
-        "annual_rev_E", (weeks_per_year / n_weeks) * revenue.sum()
-    )
+        m.expression("annual_rev_h2", (52.0 / n_weeks) * h2_rev.sum())
+    if "tank" in units:
+        m.expression("annual_rev_E", (52.0 / n_weeks) * (revenue - om).sum())
+    else:
+        m.expression("annual_rev_E", (52.0 / n_weeks) * revenue.sum())
     m.expression("NPV", npv)
     m.maximize(npv * 1e-5)
     return m

@@ -18,7 +18,6 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from .h2 import (
-    DH_RXN_R1,
     STOICH_R1,
     isentropic_temperature,
     mix_enthalpy_flow,
@@ -70,10 +69,17 @@ def turbine_chain(
     W_comp = W_s / eta_compressor
     T2 = temperature_from_enthalpy(n_feed, H1 + W_comp, T2s)
 
-    # adiabatic stoichiometric combustor: extent = conversion * nH2 / 2
+    # adiabatic stoichiometric combustor: extent = conversion * nH2 / 2.
+    # NOTE the enthalpy table is formation-referenced for water — the
+    # reference zeroes the Shomate H coefficient (`hturbine_ideal_vap.py:152`,
+    # "'H': (0.0,  # [2] -241.8264"), so h_water(298 K) = -241.8 kJ/mol and
+    # the combustion heat is released by the composition change itself. Adding
+    # DH_RXN_R1 on top would double-count it: the reference's solved operating
+    # point matches the formation-only balance (avg_turb_eff 1.51,
+    # `test_RE_flowsheet.py:174`), which pins this convention.
     extent = conversion * n_feed[..., 0] / 2.0
     n_out = n_feed + extent[..., None] * STOICH_R1
-    H3 = mix_enthalpy_flow(n_feed, T2) - DH_RXN_R1 * extent
+    H3 = mix_enthalpy_flow(n_feed, T2)
     T3 = temperature_from_enthalpy(n_out, H3, T2 + 1500.0 * extent / jnp.maximum(jnp.sum(n_out, -1), 1e-12))
 
     # expander back to p_in

@@ -1,0 +1,109 @@
+"""The reference's flagship golden-dollar tests, reproduced from the
+in-snapshot data.
+
+Mirrors `dispatches/case_studies/renewables_case/tests/test_RE_flowsheet.py`
+(`test_wind_battery_optimize` :127-137, `test_wind_pem_optimize` :140-151,
+`test_wind_battery_pem_optimize` :154-163,
+`test_wind_battery_pem_tank_turb_optimize_simple` :166-176): DA LMPs are the
+second array of the vendored ``rts_results_all_prices.npy`` clipped at $200,
+and hourly wind CFs come from the vendored Wind Toolkit SRW speeds through
+the PySAM-parity Weibull powercurve model
+(`units/powercurve.py::capacity_factor_pysam`, calibrated per
+tools/calibrate_pysam_cf.py — PySAM itself is not installable here).
+
+Tolerances are the reference's own (rel 1e-3 on the wind+battery dollars,
+rel 1e-2 / abs 3 MW on the design cases) with two documented exceptions
+where the reference's tolerance encodes bit-level CBC/IPOPT determinism
+rather than model agreement: its ``annual_rev_h2 == approx(99396474,
+abs=5e3)`` (rel 5e-8) and exact-zero size assertions; we assert those at
+rel 1e-2 / abs 1e-3 MW respectively.
+"""
+import numpy as np
+import pytest
+
+from dispatches_tpu.case_studies.renewables import params as P
+from dispatches_tpu.case_studies.renewables.pricetaker import (
+    wind_battery_optimize,
+    wind_battery_pem_optimize,
+    wind_battery_pem_tank_turb_optimize,
+)
+
+GOLD = P.load_re_goldens()
+LMPS = GOLD["da_lmp"]
+CFS = GOLD["wind_cf"]
+
+
+def test_goldens_inputs_shapes():
+    assert LMPS.shape == (8736,)
+    assert float(LMPS.max()) == 200.0  # clipped (`test_RE_flowsheet.py:31`)
+    assert GOLD["wind_speed_m_s"].shape == (8760,)
+    assert CFS.shape == (8760,)
+    assert 0.0 <= CFS.min() and CFS.max() <= 1.0
+
+
+def test_wind_battery_golden():
+    """`test_RE_flowsheet.py:127-137`: NPV 666,049,365, revenue 59,163,455
+    (rel 1e-3), battery sized to zero."""
+    res = wind_battery_optimize(7 * 24, LMPS, CFS)
+    assert res["converged"]
+    assert res["NPV"] == pytest.approx(666_049_365, rel=1e-3)
+    assert res["annual_revenue"] == pytest.approx(59_163_455, rel=1e-3)
+    assert res["batt_kw"] == pytest.approx(0.0, abs=1.0)  # kW, ref abs=1
+
+
+def test_wind_pem_golden():
+    """`test_RE_flowsheet.py:140-151`: PEM 487 MW, H2 revenue 155,129,116,
+    elec revenue 68,599,396, NPV 1,339,462,317 (rel 1e-2)."""
+    res = wind_battery_pem_optimize(
+        6 * 24, LMPS, CFS, h2_price_per_kg=2.5, design_opt="PEM"
+    )
+    assert res["converged"]
+    assert res["batt_kw"] == pytest.approx(0.0, abs=1.0)
+    assert res["pem_kw"] * 1e-3 == pytest.approx(487, rel=1e-2)
+    assert res["annual_rev_h2"] == pytest.approx(155_129_116, rel=1e-2)
+    assert res["annual_rev_E"] == pytest.approx(68_599_396, rel=1e-2)
+    assert res["NPV"] == pytest.approx(1_339_462_317, rel=1e-2)
+
+
+def test_wind_battery_pem_golden():
+    """`test_RE_flowsheet.py:154-163`: with the battery free to size
+    (design_opt=True) the optimum still puts it at zero and lands on the
+    same PEM design."""
+    res = wind_battery_pem_optimize(
+        6 * 24, LMPS, CFS, h2_price_per_kg=2.5, design_opt=True
+    )
+    assert res["converged"]
+    assert res["batt_kw"] * 1e-3 == pytest.approx(0.0, abs=1e-3)  # MW
+    assert res["pem_kw"] * 1e-3 == pytest.approx(487, abs=5)
+    assert res["annual_rev_h2"] == pytest.approx(155_129_116, rel=1e-2)
+    assert res["annual_rev_E"] == pytest.approx(68_599_396, rel=1e-2)
+    assert res["NPV"] == pytest.approx(1_339_462_317, rel=1e-2)
+
+
+def test_wind_battery_pem_tank_turb_golden():
+    """`test_RE_flowsheet.py:166-176`: at h2_price $2/kg the tank and
+    turbine size to zero, PEM to ~355 MW, NPV 1,018,975,372 (rel 1e-2)."""
+    res = wind_battery_pem_tank_turb_optimize(
+        6 * 24, LMPS, CFS, h2_price_per_kg=2.0, design_opt=True
+    )
+    assert res["converged"]
+    assert res["NPV"] == pytest.approx(1_018_975_372, rel=1e-2)
+    assert res["batt_kw"] * 1e-3 == pytest.approx(0.0, abs=3)
+    assert res["pem_kw"] * 1e-3 == pytest.approx(355, abs=3)
+    assert res["tank_mol"] / P.H2_MOLS_PER_KG == pytest.approx(0.0, abs=3)
+    assert res["turb_kw"] * 1e-3 == pytest.approx(0.0, abs=3)
+    # ref asserts abs=5e3 (rel 5e-8 — CBC bit-determinism); we assert model
+    # agreement at rel 1e-2
+    assert res["annual_rev_h2"] == pytest.approx(99_396_474, rel=1e-2)
+    assert res["annual_rev_E"] == pytest.approx(28_711_076, rel=1e-2)
+
+
+def test_avg_turbine_efficiency_golden():
+    """`test_RE_flowsheet.py:174`: avg turbine/compressor work ratio ~1.51
+    (rel 1e-1). In the LP linearization the ratio is flow-independent, so it
+    is a property of the thermodynamic chain at the fixed operating point."""
+    from dispatches_tpu.properties.hturbine import turbine_chain
+
+    st = turbine_chain(1.0)
+    eff = float(-st.work_turbine / st.work_compressor)
+    assert eff == pytest.approx(1.51, rel=1e-1)

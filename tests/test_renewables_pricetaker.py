@@ -1,11 +1,12 @@
-"""Renewables price-taker golden tests.
+"""Renewables price-taker solver-correctness tests (RTS bus-303 data).
 
-Strategy (SURVEY.md §4): the reference's dollar goldens are tied to a data CSV
-absent from the snapshot, so each workload is validated against (a) a CPU
-HiGHS solve of the *identical* LP (must match to 1e-6 rel) and (b) closed-form
-hand computations of the dispatch economics where available. Structural
-behavior (battery size -> 0 at these prices, PEM sized > 0 at h2_price=2.5)
-mirrors the reference tests (`test_RE_flowsheet.py:127-181`).
+Strategy (SURVEY.md §4): each workload is validated against (a) a CPU HiGHS
+solve of the *identical* LP (must match to 1e-6 rel) and (b) closed-form hand
+computations of the dispatch economics where available, using the RTS-GMLC
+bus-303 LMP/CF series. The reference's golden-dollar results themselves
+(NPV 666,049,365 etc.) are reproduced from the reference's own test inputs
+(vendored `rts_results_all_prices.npy` + Wind Toolkit SRW speeds through the
+PySAM-parity powercurve) in `tests/test_re_goldens.py`.
 """
 import numpy as np
 import pytest

@@ -95,12 +95,17 @@ def test_simple_tank_holdup_balance():
 
 def test_turbine_thermo_chain():
     """Physical sanity of the compressor→combustor→expander chain
-    (cf. `hydrogen_turbine_unit.py:97-167`): net production positive, combustor
-    hot, net specific output ~20-40 kWh/kg H2."""
+    (cf. `hydrogen_turbine_unit.py:97-167`): net production positive,
+    combustor hot (adiabatic flame with 10.76:1 air dilution), net specific
+    output ~8-20 kWh/kg H2 (a simple-cycle gas-turbine efficiency of ~25-60%
+    of H2's 33.3 kWh/kg LHV), and the turbine/compressor work ratio matching
+    the reference's solved operating point (~1.51, `test_RE_flowsheet.py:174`,
+    asserted tightly in test_re_goldens)."""
     from dispatches_tpu.properties.hturbine import turbine_chain
 
     st = turbine_chain(1.0)
     assert float(st.net_power) > 0
-    assert 1500 < float(st.T_reactor_out) < 3000
+    assert 1200 < float(st.T_reactor_out) < 2000
     kwh_per_kg = float(st.net_power) / 1e3 / (0.99 * 2.016e-3 * 3600)
-    assert 20 < kwh_per_kg < 40
+    assert 8 < kwh_per_kg < 20
+    assert 1.3 < float(-st.work_turbine / st.work_compressor) < 1.7
