@@ -566,7 +566,8 @@ def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time"):
 
 
 def _banded_ops(
-    Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None, slabs=None, mesh=None
+    Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None, slabs=None, mesh=None,
+    chol_dtype=None, kkt_refine=0,
 ):
     """(matvec, rmatvec, make_kkt_solver) for `ipm._solve_scaled`, operating
     on flat vectors laid out [Tb*nB time-cols | p border-cols] (x-space) and
@@ -583,7 +584,18 @@ def _banded_ops(
     exactly zero, so dy stays 0 either way — but a reg_d-only diagonal puts
     a 1/reg_d eigenvalue into K^-1 that amplifies f32 rounding noise
     catastrophically over long factorization chains (the year-scale f32
-    failure mode: breakdown by iteration 5 at Tb=365)."""
+    failure mode: breakdown by iteration 5 at Tb=365).
+
+    Mixed precision: with `chol_dtype` (e.g. float32) below the data dtype
+    (float64), the O(mB^3) normal-equations build + block Cholesky +
+    triangular solves run in `chol_dtype` while `kkt_refine` steps of
+    iterative refinement — residuals via the O(mB^2) banded K matvec in the
+    FULL dtype — recover full-dtype direction accuracy. This is the
+    f32-speed / f64-accuracy year path (VJP-free classic mixed-precision
+    refinement); a refinement step that makes the residual worse (the f32
+    factor's conditioning limit at late barrier iterations) is rejected, so
+    accuracy degrades gracefully to the plain-f32 direction instead of
+    diverging."""
     dtype = Ad.dtype
     nt = Tb * nB
     diag_shift = jnp.asarray(reg_d, dtype) * jnp.eye(mB, dtype=dtype)
@@ -614,21 +626,52 @@ def _banded_ops(
         wb = w[nt:]
         db = d[nt:]
         wprev = _shift_down(wt)
-        Ds = jnp.einsum("tij,tj,tkj->tik", Ad, wt, Ad)
-        Ds = Ds + jnp.einsum("tij,tj,tkj->tik", As, wprev, As)
-        Ds = Ds + diag_shift
-        Es = jnp.einsum("tij,tj,tkj->tik", As, wprev, _shift_down(Ad))
+        cd = chol_dtype or dtype
+        Ad_c, As_c = Ad.astype(cd), As.astype(cd)
+        wt_c, wprev_c = wt.astype(cd), wprev.astype(cd)
+        Ds = jnp.einsum("tij,tj,tkj->tik", Ad_c, wt_c, Ad_c)
+        Ds = Ds + jnp.einsum("tij,tj,tkj->tik", As_c, wprev_c, As_c)
+        Ds = Ds + diag_shift.astype(cd)
+        Es = jnp.einsum("tij,tj,tkj->tik", As_c, wprev_c, _shift_down(Ad_c))
         if slabs:
             fac = _slab_chol(Ds, Es, slabs, mesh=mesh)
 
-            def base(rt):
-                return _slab_solve(fac, rt, mesh=mesh)
+            def chol_base(rt):
+                return _slab_solve(fac, rt.astype(cd), mesh=mesh).astype(dtype)
 
         else:
             Ls, Cs = _block_chol(Ds, Es)
 
+            def chol_base(rt):
+                return _bt_solve(Ls, Cs, rt.astype(cd)).astype(dtype)
+
+        if kkt_refine and cd != dtype:
+            # K y = A_t W_t A_t^T y + diag_shift y, all in the full dtype;
+            # y is (Tb, mB) or (Tb, mB, k)
+            def K_mul(y):
+                y3 = y[..., None] if y.ndim == 2 else y
+                xt = jnp.einsum("tij,tik->tjk", Ad, y3)
+                xt = xt + _shift_up(jnp.einsum("tij,tik->tjk", As, y3))
+                xt = xt * wt[..., None]
+                out = jnp.einsum("tij,tjk->tik", Ad, xt)
+                out = out + jnp.einsum("tij,tjk->tik", As, _shift_down(xt))
+                out = out + jnp.einsum("tij,tjk->tik", diag_shift, y3)
+                return out[..., 0] if y.ndim == 2 else out
+
             def base(rt):
-                return _bt_solve(Ls, Cs, rt)
+                x = chol_base(rt)
+                res = rt - K_mul(x)
+                for _ in range(kkt_refine):
+                    x_try = x + chol_base(res)
+                    res_try = rt - K_mul(x_try)
+                    # reject steps past the f32 factor's conditioning limit
+                    better = jnp.sum(res_try * res_try) < jnp.sum(res * res)
+                    x = jnp.where(better, x_try, x)
+                    res = jnp.where(better, res_try, res)
+                return x
+
+        else:
+            base = chol_base
 
         if p:
             # Woodbury: K = Kb + B diag(wb) B^T
