@@ -1,29 +1,169 @@
-"""Benchmark: LMP-scenario price-taker LP solves/sec/chip on TPU.
+"""Benchmark: LMP-scenario price-taker LP solves on TPU, weekly + year scale.
 
 The reference hot path (BASELINE.md): one Pyomo model rebuild + one CBC/IPOPT
 subprocess solve per LMP scenario per sweep point
 (`wind_battery_LMP.py:195-267`), at weekly granularity
 (`load_parameters.py:104` reshapes the year to 52x168 h). Here the identical
 wind+battery+PEM weekly LP is lowered once and a vmapped interior-point solve
-runs the whole scenario x week batch on one chip.
+runs the whole scenario x week batch on one chip. Two year-scale rows ride
+along: one monolithic 8,760-h design LP (mixed-precision block-tridiagonal
+IPM, gated on objective error vs HiGHS), and a scenario-BATCH of year LPs
+(the BASELINE.md north-star axis).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 `vs_baseline` is measured against scipy HiGHS solving the same LPs on the host
 CPU (the same solver class the reference shells out to), solves/sec per chip
 vs solves/sec per CPU process.
+
+Resilience (round-4, after three rounds of rc=1 on tunnel outages): every
+device call runs under retry-with-backoff (7 attempts over ~7.5 min on
+tunnel/backend errors). On final failure a diagnostics file BENCH_DIAG.json
+is written and the printed JSON says where it died; on success a timestamped
+BENCH_LOCAL.json records the full result so a later capture-time outage
+cannot erase a measured number.
 """
+import datetime
 import json
 import os
 import sys
 import time
+import traceback
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Error signatures of the axon TPU tunnel / PJRT backend being transiently
+# unavailable (observed rounds 1-3: "Unable to initialize backend 'axon':
+# UNAVAILABLE", connection refused at the first device call).
+_RETRYABLE = (
+    "unavailable",
+    "unable to initialize backend",
+    "failed to connect",
+    "connection refused",
+    "connection reset",
+    "deadline exceeded",
+    "socket",
+    "tunnel",
+    "transport",
+)
+_DELAYS = (15, 30, 45, 60, 90, 120, 120)  # 7 retries over 480 s
+
+
+_DIAG = {"attempts": [], "stage_times": {}}
+
+
+def _now():
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def _write_diag(stage, fatal_error=None):
+    _DIAG["failed_stage"] = stage
+    _DIAG["ts"] = _now()
+    if fatal_error:
+        _DIAG["fatal_error"] = fatal_error
+    with open(os.path.join(REPO, "BENCH_DIAG.json"), "w") as f:
+        json.dump(_DIAG, f, indent=1)
+
+
+def _fail(stage, n_attempts):
+    _write_diag(stage)
+    print(
+        json.dumps(
+            {
+                "metric": f"BENCH FAILED: device unavailable at stage "
+                f"'{stage}' after {n_attempts} attempts over "
+                f"{sum(_DELAYS)}s backoff (diagnostics: BENCH_DIAG.json)",
+                "value": 0.0,
+                "unit": "error",
+                "vs_baseline": 0.0,
+            }
+        )
+    )
+    sys.exit(1)
+
+
+class _StageTimeout(Exception):
+    pass
+
+
+def _device(stage, fn, timeout_s=900.0):
+    """Run a device-touching thunk under retry-with-backoff AND a watchdog.
+
+    Retries only on tunnel/backend-availability signatures; a genuine bug
+    re-raises at once (after writing diagnostics) so the traceback reaches
+    the driver log. The watchdog covers the tunnel's third failure mode —
+    calls that HANG instead of erroring (observed round 4: a warmup batch
+    blocked >15 min at 0% CPU) — by running the thunk in a worker thread
+    and abandoning it past `timeout_s` (the stuck thread cannot be killed,
+    but the bench can move on to retry or fail with diagnostics)."""
+    import queue as _queue
+    import threading
+
+    def run_with_watchdog():
+        # plain daemon thread (NOT ThreadPoolExecutor: its atexit hook
+        # joins workers, so a stuck tunnel call would hang process exit)
+        q = _queue.Queue()
+
+        def worker():
+            try:
+                q.put(("ok", fn()))
+            except Exception as exc:  # delivered to the retry loop below
+                q.put(("err", exc))
+
+        threading.Thread(target=worker, daemon=True).start()
+        try:
+            kind, val = q.get(timeout=timeout_s)
+        except _queue.Empty:
+            raise _StageTimeout(
+                f"device call hung > {timeout_s:.0f}s (tunnel "
+                "unavailable-by-hang)"
+            )
+        if kind == "err":
+            raise val
+        return val
+
+    for i, delay in enumerate((0,) + _DELAYS):
+        if delay:
+            time.sleep(delay)
+        t0 = time.perf_counter()
+        try:
+            out = run_with_watchdog()
+            _DIAG["stage_times"][stage] = round(time.perf_counter() - t0, 3)
+            return out
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
+            _DIAG["attempts"].append(
+                {"stage": stage, "attempt": i + 1, "ts": _now(),
+                 "error": msg[:4000]}
+            )
+            # flush diagnostics after EVERY failed attempt (not only at
+            # final failure): a later hard kill must not erase the record
+            _write_diag(stage)
+            print(
+                f"bench: stage '{stage}' attempt {i + 1} failed: "
+                f"{msg[:300]}",
+                file=sys.stderr,
+                flush=True,
+            )
+            if isinstance(e, _StageTimeout):
+                continue  # retryable by definition
+            if not any(pat in msg.lower() for pat in _RETRYABLE):
+                _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
+                raise
+    _fail(stage, len(_DELAYS) + 1)
+
 
 def main():
+    t_start = time.perf_counter()
+    # x64 on: every f32 tensor below is EXPLICIT; without this the
+    # "f64 HiGHS reference" inputs (yp64, cpu_lps, yb_ref) would silently
+    # truncate to f32 and the reported rel_err fields would measure input
+    # quantization, not solver accuracy
+    jax.config.update("jax_enable_x64", True)
     from dispatches_tpu.case_studies.renewables import params as P
     from dispatches_tpu.case_studies.renewables.pricetaker import (
         HybridDesign,
@@ -31,6 +171,19 @@ def main():
     )
     from dispatches_tpu.solvers.ipm import solve_lp
     from dispatches_tpu.solvers.reference import solve_lp_scipy
+
+    # liveness probe with a fresh random input (the tunnel memoizes
+    # (executable, inputs) -> outputs across processes; a constant probe
+    # could be served from cache without touching the chip)
+    seed_rng = np.random.default_rng(time.time_ns() % (2**32))
+    probe_val = float(seed_rng.uniform(1.0, 2.0))
+    got = _device(
+        "probe",
+        lambda: float(np.asarray(jnp.sqrt(jnp.asarray(probe_val)))),
+        timeout_s=180.0,  # a scalar op; minutes mean the tunnel is wedged
+    )
+    assert abs(got - probe_val**0.5) < 1e-5
+    _DIAG["devices"] = [str(d) for d in jax.devices()]
 
     T = 168  # one week per LP (reference weekly granularity)
     n_weeks = 52
@@ -49,9 +202,7 @@ def main():
 
     lmp_weeks = data["da_lmp"].reshape(n_weeks, T)
     cf_weeks = data["da_wind_cf"].reshape(n_weeks, T)
-    # fresh scenario draws every run: the TPU tunnel memoizes the most recent
-    # (executable, inputs) -> outputs across processes, so a fixed seed would
-    # let the timed call replay a previous process's cached result
+    # fresh scenario draws every run: see the memoization note on the probe
     rng = np.random.default_rng(time.time_ns() % (2**32))
     scale = rng.uniform(0.5, 2.0, n_scenarios)
     # batch axis = scenario x week
@@ -60,33 +211,55 @@ def main():
     cfs = cfs.astype(np.float32)
     B = lmps.shape[0]
 
-    tol = 3e-6  # f32 on TPU; NPV golden tolerance is 1e-3 rel
+    # f32 solve tolerance: 1e-6, not 1e-5 — at 1e-5 the merit criterion can
+    # fire a few iterations before the vertex is resolved, leaving the
+    # objective ~1e-3 off (see tests/test_f32_tier.py F32_KW note)
+    tol = 1e-6
 
     def solve_batch(lmp_b, cf_b):
         def one(lm, cf):
             lp = prog.instantiate({"lmp": lm, "wind_cf": cf}, dtype=jnp.float32)
-            sol = solve_lp(lp, tol=tol, max_iter=50, refine_steps=2)
+            sol = solve_lp(lp, tol=tol, max_iter=60, refine_steps=2)
             return sol.obj, sol.converged, sol.iterations
 
         return jax.vmap(one)(lmp_b, cf_b)
 
     fn = jax.jit(solve_batch)
+
+    # small-batch jit probe BEFORE the big batch: if this works but the
+    # full batch hangs, the tunnel compiles/executes small programs fine
+    # and the failure is size-related — diagnosable from stage_times
+    def _probe_small():
+        k = 4
+        obj, conv, _ = jax.jit(solve_batch)(
+            jnp.asarray(lmps[:k] * np.float32(rng.uniform(0.9, 1.1)), jnp.float32),
+            jnp.asarray(cfs[:k]),
+        )
+        return float(np.asarray(obj).sum()), np.asarray(conv).all()
+
+    _device("weekly jit probe (B=4)", _probe_small, timeout_s=600.0)
+
     # warmup/compile on DIFFERENT data than the timed run — identical input
     # buffers can be served from a cached execution on some backends, which
     # silently turns the timed call into a no-op (round-2 lesson: 723k
     # "solves/sec" that were really ~16)
     warm_scale = rng.uniform(0.5, 2.0, n_scenarios)
     warm_lmps = (warm_scale[:, None, None] * lmp_weeks[None]).reshape(-1, T)
-    obj, conv, iters = fn(jnp.asarray(warm_lmps, jnp.float32), jnp.asarray(cfs))
-    np.asarray(obj)  # block_until_ready does not block on the tunnel
-    # backend; a device->host transfer is the only real synchronization
 
-    t0 = time.perf_counter()
-    obj, conv, iters = fn(jnp.asarray(lmps), jnp.asarray(cfs))
-    obj = np.asarray(obj)
-    conv = np.asarray(conv)
-    iters = np.asarray(iters)
-    dt = time.perf_counter() - t0
+    def _warm():
+        obj, conv, iters = fn(jnp.asarray(warm_lmps, jnp.float32), jnp.asarray(cfs))
+        return np.asarray(obj)  # device->host transfer is the only real
+        # synchronization over the tunnel (block_until_ready does not block)
+
+    _device("weekly warmup/compile", _warm)
+
+    def _timed():
+        t0 = time.perf_counter()
+        obj, conv, iters = fn(jnp.asarray(lmps), jnp.asarray(cfs))
+        obj = np.asarray(obj)
+        return obj, np.asarray(conv), np.asarray(iters), time.perf_counter() - t0
+
+    obj, conv, iters, dt = _device("weekly timed batch", _timed)
     solves_per_sec = B / dt
     conv_frac = float(np.mean(conv))
     med_iters = float(np.median(iters))
@@ -94,6 +267,7 @@ def main():
     # Convergence gate: a throughput number for solves that did not converge
     # is not a benchmark (round-1 lesson: 679k "solves/sec" at converged=0).
     if conv_frac < 0.99:
+        _write_diag("weekly convergence gate")
         print(
             json.dumps(
                 {
@@ -134,11 +308,16 @@ def main():
         np.max(np.abs(dev_objs - np.asarray(cpu_objs)) / (1.0 + np.abs(cpu_objs)))
     )
 
-    # year-scale row: one monolithic 8,760-h design LP (M=87,601) via the
-    # block-tridiagonal structured IPM (solvers/structured.py)
+    # ------------------------------------------------------------------
+    # Year rows: the 8,760-h design LP via the block-tridiagonal IPM
+    # (solvers/structured.py). Reference anchor: the reference can only
+    # solve the year monolithically on CPU (`price_taker_analysis.py:
+    # 181-224`); BASELINE.md's north-star is 8,760 h x 500 scenarios.
+    from dispatches_tpu.solvers.reference import solve_lp_scipy_sparse
     from dispatches_tpu.solvers.structured import (
         extract_time_structure,
         solve_lp_banded,
+        solve_lp_banded_batch,
     )
 
     Ty = 8760
@@ -153,41 +332,153 @@ def main():
     yprog, _ = build_pricetaker(ydesign)
     ylmp = np.tile(lmp_weeks.reshape(-1), 2)[:Ty] * rng.uniform(0.95, 1.05, Ty)
     ycf = np.tile(cf_weeks.reshape(-1), 2)[:Ty]
-    # substructured (SPIKE) decomposition: 8 slabs of 15 blocks — measured
-    # ~1.35x faster than the best sequential-scan config (bh=120) on one
-    # chip, and the same code shards one-slab-per-device on a mesh
+
+    # HiGHS year objective for the SAME fresh inputs: the accuracy gate
+    # (~25 s on host; runs while nothing is queued on the chip)
+    yp64 = {"lmp": jnp.asarray(ylmp, jnp.float64),
+            "wind_cf": jnp.asarray(ycf, jnp.float64)}
+    yref = solve_lp_scipy_sparse(yprog, yp64)
+
+    # single-year row: 8-slab SPIKE decomposition, f32 data + f32 factor
+    # with full-precision-in-dtype refinement; gated on objective error
+    # against HiGHS, not just `converged`
     ymeta = extract_time_structure(yprog, Ty, block_hours=73)
+    ykw = dict(tol=1e-5, max_iter=80, refine_steps=3, slabs=8)
     yparams = {
         "lmp": jnp.asarray(ylmp, jnp.float32),
         "wind_cf": jnp.asarray(ycf, jnp.float32),
     }
-    ykw = dict(tol=1e-5, max_iter=80, refine_steps=3, slabs=8)
-    yblp = ymeta.instantiate(yparams, dtype=jnp.float32)
-    ysol = solve_lp_banded(ymeta, yblp, **ykw)
-    np.asarray(ysol.obj)  # sync (warm compile)
-    yblp2 = ymeta.instantiate(
-        {"lmp": yparams["lmp"] * (1 + 1e-6), "wind_cf": yparams["wind_cf"]},
-        dtype=jnp.float32,
-    )
-    t0 = time.perf_counter()
-    ysol = solve_lp_banded(ymeta, yblp2, **ykw)
-    yconv = bool(np.asarray(ysol.converged))
-    ydt = time.perf_counter() - t0
 
-    print(
-        json.dumps(
-            {
-                "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
-                f"(T=168h, batch={B}, converged={conv_frac:.3f}, "
-                f"median_iters={med_iters:.0f}, max_rel_err_vs_highs={rel_err:.1e}; "
-                f"year-scale: one 8760h monolithic design LP in {ydt:.1f}s "
-                f"f32 block-tridiag IPM 8-slab SPIKE, converged={yconv})",
-                "value": round(solves_per_sec, 3),
-                "unit": "solves/sec",
-                "vs_baseline": round(solves_per_sec / cpu_solves_per_sec, 2),
-            }
+    def _year_warm():
+        yblp = ymeta.instantiate(yparams, dtype=jnp.float32)
+        ysol = solve_lp_banded(ymeta, yblp, **ykw)
+        return np.asarray(ysol.obj)
+
+    _device("year warmup/compile", _year_warm)
+
+    def _year_timed():
+        yblp2 = ymeta.instantiate(
+            {"lmp": yparams["lmp"] * np.float32(1 + 1e-6),
+             "wind_cf": yparams["wind_cf"]},
+            dtype=jnp.float32,
         )
+        t0 = time.perf_counter()
+        ysol = solve_lp_banded(ymeta, yblp2, **ykw)
+        yobj = float(np.asarray(ysol.obj))
+        return yobj, bool(np.asarray(ysol.converged)), time.perf_counter() - t0
+
+    yobj, yconv, ydt = _device("year timed solve", _year_timed)
+    yerr = abs(yobj - yref.obj_with_offset) / max(1.0, abs(yref.obj_with_offset))
+    # f32 year floor is ~1% (objective is a revenue-cost difference with
+    # heavy cancellation); 5e-2 is the round-3 contract for pure f32
+    yok = yconv and yerr < 5e-2
+
+    # scenario-batched year row (north-star axis): B_y simultaneous 8,760-h
+    # design LPs, shared banded structure, per-scenario LMP draws, one vmap
+    By = int(os.environ.get("BENCH_YEAR_BATCH", "8"))
+    ybmeta = extract_time_structure(yprog, Ty, block_hours=24)
+    yscales = rng.uniform(0.7, 1.4, By).astype(np.float32)
+
+    def _batch_params(scales):
+        lmp_b = jnp.asarray(scales[:, None] * ylmp[None, :], jnp.float32)
+        return {
+            "lmp": lmp_b,
+            "wind_cf": jnp.asarray(ycf, jnp.float32),
+        }
+
+    def _instantiate_batch(scales):
+        pb = _batch_params(scales)
+        return jax.vmap(
+            lambda lm: ybmeta.instantiate(
+                {"lmp": lm, "wind_cf": pb["wind_cf"]}, dtype=jnp.float32
+            )
+        )(pb["lmp"])
+
+    ybkw = dict(tol=1e-5, max_iter=80, refine_steps=3)
+
+    def _ybatch_warm():
+        blp_b = _instantiate_batch(rng.uniform(0.7, 1.4, By).astype(np.float32))
+        sol = solve_lp_banded_batch(ybmeta, blp_b, **ybkw)
+        return np.asarray(sol.obj)
+
+    _device("year-batch warmup/compile", _ybatch_warm)
+
+    def _ybatch_timed():
+        blp_b = _instantiate_batch(yscales)
+        t0 = time.perf_counter()
+        sol = solve_lp_banded_batch(ybmeta, blp_b, **ybkw)
+        objs = np.asarray(sol.obj)
+        return objs, np.asarray(sol.converged), time.perf_counter() - t0
+
+    ybobjs, ybconv, ybdt = _device("year-batch timed solve", _ybatch_timed)
+    yb_conv_frac = float(np.mean(ybconv))
+    scen_years_per_min = By / ybdt * 60.0
+    t500 = 500.0 / (By / ybdt)  # projected single-chip 500-scenario time
+    # accuracy spot-check: scenario 0 vs HiGHS on the same scaled inputs
+    yb_ref = solve_lp_scipy_sparse(
+        yprog,
+        {"lmp": jnp.asarray(yscales[0] * ylmp, jnp.float64),
+         "wind_cf": jnp.asarray(ycf, jnp.float64)},
     )
+    yb_err = abs(float(ybobjs[0]) - yb_ref.obj_with_offset) / max(
+        1.0, abs(yb_ref.obj_with_offset)
+    )
+
+    result = {
+        "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
+        f"(T=168h, batch={B}, converged={conv_frac:.3f}, "
+        f"median_iters={med_iters:.0f}, max_rel_err_vs_highs={rel_err:.1e}; "
+        f"year 8760h monolithic: {ydt:.1f}s f32 8-slab SPIKE, "
+        f"converged={yconv}, rel_err_vs_highs={yerr:.1e}, gate_ok={yok}; "
+        f"year x{By} scenario BATCH: {ybdt:.1f}s for {By} year-LPs = "
+        f"{scen_years_per_min:.1f} scenario-years/min/chip, "
+        f"converged={yb_conv_frac:.2f}, scen0_rel_err_vs_highs={yb_err:.1e}, "
+        f"projected 500 scenarios = {t500 / 60.0:.1f} min/chip)",
+        "value": round(solves_per_sec, 3),
+        "unit": "solves/sec",
+        "vs_baseline": round(solves_per_sec / cpu_solves_per_sec, 2),
+    }
+    if not yok:
+        result["metric"] = "YEAR GATE FAILED (see fields): " + result["metric"]
+
+    # timestamped local success artifact: a capture-time outage must not
+    # erase a measured number (round-3 verdict, Weak #3)
+    with open(os.path.join(REPO, "BENCH_LOCAL.json"), "w") as f:
+        json.dump(
+            {
+                "ts": _now(),
+                "result": result,
+                "detail": {
+                    "weekly": {
+                        "batch": B,
+                        "solves_per_sec": solves_per_sec,
+                        "converged": conv_frac,
+                        "median_iters": med_iters,
+                        "rel_err_vs_highs": rel_err,
+                        "cpu_highs_solves_per_sec": cpu_solves_per_sec,
+                    },
+                    "year_single": {
+                        "seconds": ydt,
+                        "converged": yconv,
+                        "rel_err_vs_highs": yerr,
+                    },
+                    "year_batch": {
+                        "B": By,
+                        "seconds": ybdt,
+                        "scenario_years_per_min": scen_years_per_min,
+                        "converged_frac": yb_conv_frac,
+                        "scen0_rel_err_vs_highs": yb_err,
+                        "projected_500_scenarios_min": t500 / 60.0,
+                    },
+                    "stage_times": _DIAG["stage_times"],
+                    "total_seconds": time.perf_counter() - t_start,
+                },
+            },
+            f,
+            indent=1,
+        )
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

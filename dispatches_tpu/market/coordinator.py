@@ -24,6 +24,11 @@ class DoubleLoopCoordinator:
         self.bidder = bidder
         self.tracker = tracker
         self.projection_tracker = projection_tracker or tracker
+        # realized day-ahead results per RUC day, captured after each RUC
+        # solve and handed to the real-time bidder (the reference bidder
+        # signature: `parametrized_bidder.py:113` takes
+        # realized_day_ahead_prices/_dispatches)
+        self._da_results = {}  # day -> (prices, dispatches)
 
     # -- static-parameter push (`coordinator.py:46-87`) ------------------
     def update_static_params(self, gen_dict: dict):
@@ -93,6 +98,9 @@ class DoubleLoopCoordinator:
                 context.register_before_ruc_solve_callback(
                     coordinator._plugin_before_ruc_solve
                 )
+                context.register_after_ruc_generation_callback(
+                    coordinator._plugin_after_ruc_generation
+                )
                 context.register_before_operations_solve_callback(
                     coordinator._plugin_before_operations_solve
                 )
@@ -116,7 +124,10 @@ class DoubleLoopCoordinator:
         gen_dict["p_cost"] = {
             "data_type": "cost_curve",
             "cost_curve_type": "piecewise",
-            "values": list(bid["p_cost"]),
+            # plain floats: Egret serializes model dicts to JSON
+            # (`egret/data/model_data.py` ModelData round-trip); a numpy
+            # scalar leaking in breaks that downstream
+            "values": [(float(mw), float(cost)) for mw, cost in bid["p_cost"]],
         }
 
     @staticmethod
@@ -142,7 +153,7 @@ class DoubleLoopCoordinator:
         # (Egret cost curves are static per solve; Prescient re-enters here
         # every RUC, so the curve tracks the forecast day by day)
         self._apply_cost_curve(gen_dict, bids[hours[0]][name])
-        pmax_series = [bids[h][name]["p_max"] for h in hours]
+        pmax_series = [float(bids[h][name]["p_max"]) for h in hours]
         # Egret wants one value per model time period (Prescient's default
         # ruc_horizon is 48 h while bidders often carry 24): cycle the bid
         # day to fill, trim if the bidder over-supplied
@@ -155,17 +166,59 @@ class DoubleLoopCoordinator:
             "values": pmax_series,
         }
 
+    def _plugin_after_ruc_generation(
+        self, options, simulator, ruc_plan, ruc_date, ruc_hour
+    ):
+        """Capture realized day-ahead results from the SOLVED RUC: the
+        participant's committed dispatch (`pg` time series) and its bus's
+        day-ahead LMPs. Handed to `compute_real_time_bids` for the rest of
+        the operating day — a parametrized RT bidder prices its tranches
+        off the DA award (reference signature:
+        `PEM_parametrized_bidder.py:94`)."""
+        day = _date_to_day(ruc_date)
+        prices = dispatches = None
+        # real Prescient hands after_ruc_generation a RucPlan wrapper, not
+        # the Egret dict itself — unwrap the deterministic instance (the
+        # reference coordinator consumes the same attribute); a bare Egret
+        # ModelData (the in-framework host / fixtures) passes through
+        ruc_md = getattr(ruc_plan, "deterministic_ruc_instance", ruc_plan)
+        try:
+            gen_dict = self._participant_gen_dict(ruc_md)
+        except (AttributeError, KeyError, TypeError):
+            gen_dict = None
+        if gen_dict is not None:
+            try:
+                pg = gen_dict.get("pg")
+                if isinstance(pg, dict) and pg.get("data_type") == "time_series":
+                    dispatches = [float(v) for v in pg["values"]]
+                elif pg is not None:
+                    dispatches = [float(pg)]
+            except (TypeError, ValueError, KeyError):
+                dispatches = None  # degrade like the price block below
+        try:
+            buses = ruc_md.data["elements"]["bus"]
+            bus = self.bidder.bidding_model_object.model_data.bus
+            lmp = buses.get(str(bus), {}).get("lmp")
+            if isinstance(lmp, dict) and lmp.get("data_type") == "time_series":
+                prices = [float(v) for v in lmp["values"]]
+        except (AttributeError, KeyError, TypeError):
+            pass
+        self._da_results[day] = (prices, dispatches)
+
     def _plugin_before_operations_solve(self, options, simulator, sced_instance):
         gen_dict = self._participant_gen_dict(sced_instance)
         if gen_dict is None:
             return
         self.update_static_params(gen_dict)
         day, hour = _sim_day_hour(simulator)
-        bids = self.compute_real_time_bids(day, hour)  # {abs_hour: {gen: bid}}
+        da_prices, da_dispatches = self._da_results.get(day, (None, None))
+        bids = self.compute_real_time_bids(
+            day, hour, da_prices, da_dispatches
+        )  # {abs_hour: {gen: bid}}
         name = self.bidder.bidding_model_object.model_data.gen_name
         bid = bids[min(bids)][name]
         self._apply_cost_curve(gen_dict, bid)
-        gen_dict["p_max"] = bid["p_max"]
+        gen_dict["p_max"] = float(bid["p_max"])
 
     def _plugin_after_operations(self, options, simulator, sced_instance, lmp_sced=None):
         gen_dict = self._participant_gen_dict(sced_instance)

@@ -47,8 +47,10 @@ STATUS_DUAL_INFEASIBLE = 3  # suspected: objective unbounded below
 _STATUS_NAMES = {
     STATUS_OPTIMAL: "optimal",
     STATUS_STALLED: "stalled",
-    STATUS_PRIMAL_INFEASIBLE: "primal_infeasible",
-    STATUS_DUAL_INFEASIBLE: "dual_infeasible",
+    # "suspected_": these are residual-signature heuristics (see
+    # `_classify_exit`), not Farkas certificates — the names say so
+    STATUS_PRIMAL_INFEASIBLE: "suspected_primal_infeasible",
+    STATUS_DUAL_INFEASIBLE: "suspected_dual_infeasible",
 }
 
 

@@ -34,21 +34,19 @@ def solve_lp_scipy(lp: LPData):
     return res
 
 
-def solve_lp_scipy_sparse(prog, params):
-    """HiGHS on the COO instantiation — the reference cross-check for
-    year-scale LPs whose dense A would not fit in memory (8,760-h horizons,
-    `price_taker_analysis.py:181-224` scale)."""
+def coo_standard_form(prog, params):
+    """COO instantiation -> (A_csc, b, c, bounds, c0) in float64 — the
+    shared assembly for every sparse host solve (LP cross-checks, the UC
+    MILP, pinned-commitment candidate costing)."""
     import scipy.sparse as sp
-    from scipy.optimize import linprog
 
     slp = prog.instantiate_coo(params)
-    M, N = prog.M, prog.N
     A = sp.coo_matrix(
         (
             np.asarray(slp.vals, np.float64),
             (np.asarray(slp.rows), np.asarray(slp.cols)),
         ),
-        shape=(M, N),
+        shape=(prog.M, prog.N),
     ).tocsc()
     l = np.asarray(slp.l, np.float64)
     u = np.asarray(slp.u, np.float64)
@@ -59,14 +57,24 @@ def solve_lp_scipy_sparse(prog, params):
         ],
         axis=1,
     )
-    res = linprog(
+    return (
+        A,
+        np.asarray(slp.b, np.float64),
         np.asarray(slp.c, np.float64),
-        A_eq=A,
-        b_eq=np.asarray(slp.b, np.float64),
-        bounds=bounds,
-        method="highs",
+        bounds,
+        float(slp.c0),
     )
+
+
+def solve_lp_scipy_sparse(prog, params):
+    """HiGHS on the COO instantiation — the reference cross-check for
+    year-scale LPs whose dense A would not fit in memory (8,760-h horizons,
+    `price_taker_analysis.py:181-224` scale)."""
+    from scipy.optimize import linprog
+
+    A, b, c, bounds, c0 = coo_standard_form(prog, params)
+    res = linprog(c, A_eq=A, b_eq=b, bounds=bounds, method="highs")
     if res.status != 0:
         raise RuntimeError(f"HiGHS failed: {res.status} {res.message}")
-    res.obj_with_offset = res.fun + float(slp.c0)
+    res.obj_with_offset = res.fun + c0
     return res

@@ -567,7 +567,7 @@ def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time"):
 
 def _banded_ops(
     Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None, slabs=None, mesh=None,
-    chol_dtype=None, kkt_refine=0,
+    chol_dtype=None, kkt_refine=0, fac_d_cap=None,
 ):
     """(matvec, rmatvec, make_kkt_solver) for `ipm._solve_scaled`, operating
     on flat vectors laid out [Tb*nB time-cols | p border-cols] (x-space) and
@@ -595,14 +595,25 @@ def _banded_ops(
     refinement); a refinement step that makes the residual worse (the f32
     factor's conditioning limit at late barrier iterations) is rejected, so
     accuracy degrades gracefully to the plain-f32 direction instead of
-    diverging."""
+    diverging.
+
+    `fac_d_cap` caps the barrier weights ONLY inside the factorized
+    preconditioner (the f32 factor breaks down past spreads ~1e12); the
+    full-dtype K matvec keeps the TRUE uncapped weights, so refinement
+    corrects the capped-factor direction toward the true Newton direction.
+    Capping in `_solve_scaled` instead (its `d_cap`) changes the KKT system
+    itself and stalls the barrier at gap ~1e-4 — measured T=768: capped
+    d stalls at rel 1.4e-2 regardless of refinement; factor-only capping
+    with kkt_refine=2 reaches rel ~1e-9 of the f64 solve."""
     dtype = Ad.dtype
     nt = Tb * nB
-    diag_shift = jnp.asarray(reg_d, dtype) * jnp.eye(mB, dtype=dtype)
+    # diagonal regularization kept as a (Tb, mB) VECTOR (not an (mB, mB)
+    # matrix): the Ds build diag-embeds it per block, and the full-dtype
+    # K_mul in the refinement path applies it by broadcast — uniform shape
+    # whether or not pad_rows is given
+    diag_vec = jnp.broadcast_to(jnp.asarray(reg_d, dtype), (Tb, mB))
     if pad_rows is not None:
-        diag_shift = diag_shift + jax.vmap(jnp.diag)(
-            jnp.asarray(pad_rows, dtype)
-        )
+        diag_vec = diag_vec + jnp.asarray(pad_rows, dtype)
 
     def matvec(x):
         xt = x[:nt].reshape(Tb, nB)
@@ -625,13 +636,19 @@ def _banded_ops(
         wt = w[:nt].reshape(Tb, nB)
         wb = w[nt:]
         db = d[nt:]
-        wprev = _shift_down(wt)
         cd = chol_dtype or dtype
+        # the factorization sees capped weights (f32-survivable spread);
+        # K_mul below sees the true ones
+        d_fac = d if fac_d_cap is None else jnp.minimum(
+            d, jnp.asarray(fac_d_cap, dtype)
+        )
+        wt_f = (1.0 / d_fac)[:nt].reshape(Tb, nB)
+        wprev_f = _shift_down(wt_f)
         Ad_c, As_c = Ad.astype(cd), As.astype(cd)
-        wt_c, wprev_c = wt.astype(cd), wprev.astype(cd)
+        wt_c, wprev_c = wt_f.astype(cd), wprev_f.astype(cd)
         Ds = jnp.einsum("tij,tj,tkj->tik", Ad_c, wt_c, Ad_c)
         Ds = Ds + jnp.einsum("tij,tj,tkj->tik", As_c, wprev_c, As_c)
-        Ds = Ds + diag_shift.astype(cd)
+        Ds = Ds + jax.vmap(jnp.diag)(diag_vec.astype(cd))
         Es = jnp.einsum("tij,tj,tkj->tik", As_c, wprev_c, _shift_down(Ad_c))
         if slabs:
             fac = _slab_chol(Ds, Es, slabs, mesh=mesh)
@@ -655,7 +672,7 @@ def _banded_ops(
                 xt = xt * wt[..., None]
                 out = jnp.einsum("tij,tjk->tik", Ad, xt)
                 out = out + jnp.einsum("tij,tjk->tik", As, _shift_down(xt))
-                out = out + jnp.einsum("tij,tjk->tik", diag_shift, y3)
+                out = out + diag_vec[..., None] * y3
                 return out[..., 0] if y.ndim == 2 else out
 
             def base(rt):
@@ -744,11 +761,14 @@ def _ruiz_banded(Ad, As, Bb, iters: int = 8):
 
 @partial(
     jax.jit,
-    static_argnames=("meta", "max_iter", "refine_steps", "d_cap", "slabs", "mesh"),
+    static_argnames=(
+        "meta", "max_iter", "refine_steps", "d_cap", "slabs", "mesh",
+        "chol_dtype", "kkt_refine",
+    ),
 )
 def _solve_banded_jit(
     meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs=None,
-    mesh=None,
+    mesh=None, chol_dtype=None, kkt_refine=0, fac_d_cap=None,
 ):
     Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0 = blp
     dtype = Ad.dtype
@@ -779,6 +799,8 @@ def _solve_banded_jit(
         ops = _banded_ops(
             Ad_s, As_s, Bb_s, Tb, mB, nB, p, reg_d,
             pad_rows=meta.pad_rows, slabs=slabs, mesh=mesh,
+            chol_dtype=chol_dtype, kkt_refine=kkt_refine,
+            fac_d_cap=fac_d_cap,
         )
         sol = _solve_scaled(
             LPData(
@@ -836,6 +858,8 @@ def solve_lp_banded(
     slabs: int = None,
     mesh=None,
     mesh_axis: str = "time",
+    chol_dtype=None,
+    kkt_refine: int = 0,
 ) -> IPMSolution:
     """Solve a time-banded LP by the block-tridiagonal IPM. Returns a
     solution with ``x`` in the CompiledLP's reduced column order, so
@@ -856,13 +880,38 @@ def solve_lp_banded(
     In f32 the barrier weights are capped (`d_cap`, default 1e12): the
     uncapped z/x spread breaks long block-factorization chains on some LMP
     draws, and the capped solve converges across seeds at Tb=73 with gaps
-    ~1e-5 (a tighter 1e10 cap biases the solution visibly; 1e12 does not)."""
+    ~1e-5 (a tighter 1e10 cap biases the solution visibly; 1e12 does not).
+
+    Mixed precision (the f32-speed / f64-accuracy year path): with the data
+    in float64, ``chol_dtype=jnp.float32`` runs the O(mB^3) normal-equations
+    build + block Cholesky + triangular solves in f32 (MXU-resident on TPU)
+    while ``kkt_refine`` steps of iterative refinement — residuals via the
+    O(mB^2) banded K matvec in f64 — recover f64 direction accuracy; a
+    refinement step that worsens the residual is rejected. Validated at
+    year scale: rel <= 1e-5 of f64-HiGHS (see
+    `tests/test_structured.py::test_year_mixed_precision_refined`)."""
     dtype = blp.Ad.dtype
+    if chol_dtype is not None:
+        chol_dtype = jnp.dtype(chol_dtype)
+        if chol_dtype == dtype:
+            chol_dtype = None  # same-dtype "mixed" precision is a no-op
     if reg_p is None:
         reg_p = 1e-13 if dtype == jnp.float64 else 1e-8
     if reg_d is None:
         reg_d = 1e-12 if dtype == jnp.float64 else 1e-7
-    if d_cap is None and dtype != jnp.float64:
+    # The barrier-weight cap protects the FACTORIZATION dtype. In pure-f32
+    # solves it must cap the solve itself (d_cap). Under mixed precision the
+    # cap moves INSIDE the preconditioner (fac_d_cap): the full-dtype K
+    # matvec keeps the true weights so kkt_refine corrects the capped-factor
+    # direction toward the true Newton direction instead of solving a
+    # different (capped) KKT system — see `_banded_ops`.
+    fac_d_cap = None
+    if chol_dtype is not None and chol_dtype != jnp.float64:
+        if kkt_refine:
+            fac_d_cap = 1e12
+        elif d_cap is None and dtype == jnp.float64:
+            d_cap = 1e12  # f32 factor, no refinement: cap the solve
+    elif d_cap is None and dtype != jnp.float64:
         d_cap = 1e12
     if slabs:
         if meta.Tb % slabs or meta.Tb // slabs < 2:
@@ -895,8 +944,70 @@ def solve_lp_banded(
             mesh = Mesh(mesh.devices, ("time",))
     return _solve_banded_jit(
         meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs,
-        mesh,
+        mesh, chol_dtype, kkt_refine, fac_d_cap,
     )
+
+
+def solve_lp_banded_batch(
+    meta: TimeStructure,
+    blp: BandedLP,
+    sharding=None,
+    **kw,
+) -> IPMSolution:
+    """vmap convenience over a leading scenario axis on any BandedLP field —
+    the scenario-batched YEAR solve (BASELINE.md north-star: 8,760 h x
+    hundreds of LMP scenarios on one program structure).
+
+    Fields without the batch axis are broadcast: the common case is a shared
+    banded structure (Ad/As/Bb) with per-scenario b/c from per-scenario LMP
+    draws — the batched analogue of the reference's per-scenario Pyomo
+    rebuild + CBC subprocess loop (`wind_battery_LMP.py:195-267`), with the
+    whole batch resident on one chip (or sharded over a mesh).
+
+    `sharding` (optional `jax.sharding.NamedSharding` with the batch axis
+    on a device axis, e.g. `NamedSharding(mesh, P("scenario"))`): batched
+    fields are constrained to it, so under `jit` XLA partitions the whole
+    vmapped solve one scenario-shard per device — scenario data parallelism
+    with zero inter-device collectives in the solve (embarrassingly
+    parallel; only the convergence reduction touches the interconnect).
+
+    Do not combine with `mesh=`/`slabs=` sharding of the time axis in one
+    call — batch over scenarios OR shard slabs over time, per mesh axis."""
+    base_ndim = {
+        "Ad": 3, "As": 3, "Bb": 3, "b": 2, "c": 2, "cb": 1,
+        "l": 2, "u": 2, "lb": 1, "ub": 1, "c0": 0,
+    }
+    if kw.get("mesh") is not None:
+        raise ValueError(
+            "solve_lp_banded_batch shards the scenario axis; pass `sharding`"
+            " (not `mesh`, which shards time slabs in the unbatched solve)"
+        )
+    axes = []
+    batch = None
+    for name, arr in zip(BandedLP._fields, blp):
+        nd = base_ndim[name]
+        if arr.ndim == nd + 1:
+            axes.append(0)
+            batch = arr.shape[0]
+        elif arr.ndim == nd:
+            axes.append(None)
+        else:
+            raise ValueError(
+                f"bad ndim for BandedLP.{name}: {arr.ndim} (expected {nd} "
+                f"or {nd + 1})"
+            )
+    if batch is None:
+        return solve_lp_banded(meta, blp, **kw)
+    if sharding is not None:
+        # placing the inputs (device_put, not with_sharding_constraint —
+        # this runs outside jit) pins the batch axis one-shard-per-device;
+        # XLA's sharding propagation then partitions the vmapped solve
+        blp = BandedLP(*(
+            jax.device_put(arr, sharding) if ax == 0 else arr
+            for arr, ax in zip(blp, axes)
+        ))
+    fn = jax.vmap(lambda d: solve_lp_banded(meta, d, **kw), in_axes=(BandedLP(*axes),))
+    return fn(blp)
 
 
 def solve_horizon(

@@ -14,12 +14,18 @@ tabulated at 1 m/s steps). The reference uses PySAM in two degenerate modes:
   NotImplementedError in the reference) — the same delta evaluation; direction
   is irrelevant for a single wake-free turbine.
 
-The ``resource_speed`` mode is reproduced *exactly* by
-`capacity_factor_pysam`: SSC's Weibull energy model is a binned-CDF
+The ``resource_speed`` mode is reproduced by `capacity_factor_pysam`,
+CALIBRATED to the reference's golden results (not independently verified
+per-hour — PySAM is not importable in this environment; the two fitted
+constants below were chosen against seven golden aggregate scalars, see
+`tools/calibrate_pysam_cf.py`): SSC's Weibull energy model is a binned-CDF
 integration over the 1 m/s powercurve grid (a smoothed right-continuous
 staircase), NOT linear interpolation — `capacity_factor_from_speed`'s
 `jnp.interp` is only a smooth approximation of it and deviates by up to
-~25% in the steep part of the curve. Use `capacity_factor_pysam` wherever
+~25% in the steep part of the curve. The staircase STRUCTURE is exact
+(validated against brute-force quadrature of the k=100 Weibull density in
+`tests/test_powercurve.py`); the calibration constants carry the residual
+hour-level uncertainty. Use `capacity_factor_pysam` wherever
 parity with the reference's PySAM-computed results matters
 (`tests/test_re_goldens.py`); the interp form remains for smooth
 design-gradient studies. A general PDF mode (probability-weighted mixture

@@ -164,8 +164,18 @@ def read_prescient_datetime_csv(path: str) -> Dict[str, np.ndarray]:
             out[key] = np.asarray(vals)
             continue
         try:
-            out[key] = np.asarray([float(v or 0.0) for v in vals])
-        except ValueError:
+            # empty/missing cells become NaN, not 0.0: a silent zero in an
+            # LMP or dispatch column fabricates a price/quantity; NaN
+            # propagates into any aggregate so the gap is visible to the
+            # consumer. `v is None` covers DictReader's restval for ragged
+            # rows.
+            out[key] = np.asarray(
+                [
+                    float(v) if (v is not None and str(v).strip()) else float("nan")
+                    for v in vals
+                ]
+            )
+        except (ValueError, TypeError):
             out[key] = np.asarray(vals)
     return out
 

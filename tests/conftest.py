@@ -20,6 +20,12 @@ if not force_virtual_cpu_mesh(8):
 jax.config.update("jax_enable_x64", True)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running at-scale validation (minutes)"
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Free compiled executables between test modules. The full suite

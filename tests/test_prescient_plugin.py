@@ -32,6 +32,9 @@ class FakeContext:
     def register_before_ruc_solve_callback(self, cb):
         self.callbacks["before_ruc_solve"] = cb
 
+    def register_after_ruc_generation_callback(self, cb):
+        self.callbacks["after_ruc_generation"] = cb
+
     def register_before_operations_solve_callback(self, cb):
         self.callbacks["before_operations_solve"] = cb
 
@@ -97,6 +100,7 @@ def test_register_plugins_registers_reference_callback_set(coordinator):
     mod.register_plugins(ctx, options=None, plugin_config=None)
     assert set(ctx.callbacks) == {
         "before_ruc_solve",
+        "after_ruc_generation",
         "before_operations_solve",
         "after_operations",
     }

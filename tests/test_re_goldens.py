@@ -28,34 +28,38 @@ from dispatches_tpu.case_studies.renewables.pricetaker import (
     wind_battery_pem_tank_turb_optimize,
 )
 
-GOLD = P.load_re_goldens()
-LMPS = GOLD["da_lmp"]
-CFS = GOLD["wind_cf"]
+# module-scoped fixture (not import-time globals): load_re_goldens does
+# file I/O plus a JAX powercurve evaluation, which must not run at pytest
+# collection when these tests are deselected (single-core host)
+@pytest.fixture(scope="module")
+def gold():
+    return P.load_re_goldens()
 
 
-def test_goldens_inputs_shapes():
-    assert LMPS.shape == (8736,)
-    assert float(LMPS.max()) == 200.0  # clipped (`test_RE_flowsheet.py:31`)
-    assert GOLD["wind_speed_m_s"].shape == (8760,)
-    assert CFS.shape == (8760,)
-    assert 0.0 <= CFS.min() and CFS.max() <= 1.0
+def test_goldens_inputs_shapes(gold):
+    lmps, cfs = gold["da_lmp"], gold["wind_cf"]
+    assert lmps.shape == (8736,)
+    assert float(lmps.max()) == 200.0  # clipped (`test_RE_flowsheet.py:31`)
+    assert gold["wind_speed_m_s"].shape == (8760,)
+    assert cfs.shape == (8760,)
+    assert 0.0 <= cfs.min() and cfs.max() <= 1.0
 
 
-def test_wind_battery_golden():
+def test_wind_battery_golden(gold):
     """`test_RE_flowsheet.py:127-137`: NPV 666,049,365, revenue 59,163,455
     (rel 1e-3), battery sized to zero."""
-    res = wind_battery_optimize(7 * 24, LMPS, CFS)
+    res = wind_battery_optimize(7 * 24, gold["da_lmp"], gold["wind_cf"])
     assert res["converged"]
     assert res["NPV"] == pytest.approx(666_049_365, rel=1e-3)
     assert res["annual_revenue"] == pytest.approx(59_163_455, rel=1e-3)
     assert res["batt_kw"] == pytest.approx(0.0, abs=1.0)  # kW, ref abs=1
 
 
-def test_wind_pem_golden():
+def test_wind_pem_golden(gold):
     """`test_RE_flowsheet.py:140-151`: PEM 487 MW, H2 revenue 155,129,116,
     elec revenue 68,599,396, NPV 1,339,462,317 (rel 1e-2)."""
     res = wind_battery_pem_optimize(
-        6 * 24, LMPS, CFS, h2_price_per_kg=2.5, design_opt="PEM"
+        6 * 24, gold["da_lmp"], gold["wind_cf"], h2_price_per_kg=2.5, design_opt="PEM"
     )
     assert res["converged"]
     assert res["batt_kw"] == pytest.approx(0.0, abs=1.0)
@@ -65,12 +69,12 @@ def test_wind_pem_golden():
     assert res["NPV"] == pytest.approx(1_339_462_317, rel=1e-2)
 
 
-def test_wind_battery_pem_golden():
+def test_wind_battery_pem_golden(gold):
     """`test_RE_flowsheet.py:154-163`: with the battery free to size
     (design_opt=True) the optimum still puts it at zero and lands on the
     same PEM design."""
     res = wind_battery_pem_optimize(
-        6 * 24, LMPS, CFS, h2_price_per_kg=2.5, design_opt=True
+        6 * 24, gold["da_lmp"], gold["wind_cf"], h2_price_per_kg=2.5, design_opt=True
     )
     assert res["converged"]
     assert res["batt_kw"] * 1e-3 == pytest.approx(0.0, abs=1e-3)  # MW
@@ -80,11 +84,11 @@ def test_wind_battery_pem_golden():
     assert res["NPV"] == pytest.approx(1_339_462_317, rel=1e-2)
 
 
-def test_wind_battery_pem_tank_turb_golden():
+def test_wind_battery_pem_tank_turb_golden(gold):
     """`test_RE_flowsheet.py:166-176`: at h2_price $2/kg the tank and
     turbine size to zero, PEM to ~355 MW, NPV 1,018,975,372 (rel 1e-2)."""
     res = wind_battery_pem_tank_turb_optimize(
-        6 * 24, LMPS, CFS, h2_price_per_kg=2.0, design_opt=True
+        6 * 24, gold["da_lmp"], gold["wind_cf"], h2_price_per_kg=2.0, design_opt=True
     )
     assert res["converged"]
     assert res["NPV"] == pytest.approx(1_018_975_372, rel=1e-2)

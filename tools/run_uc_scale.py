@@ -1,0 +1,68 @@
+"""At-scale UC optimality sweep -> UC_SCALE.json (round-3 verdict #6).
+
+Validates LP-relax + Lagrangian price-response + rounding/repair commitment
+(`market/network.py::OptimizingUnitCommitment`) against the exact sparse
+HiGHS MILP on synthesized RTS-like fleets at real RUC scale
+(30-70 units x 48 h; Prescient RUC anchor `prescient_options.py:32-38`).
+
+Run:  python tools/run_uc_scale.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dispatches_tpu.parallel.mesh import force_virtual_cpu_mesh
+
+force_virtual_cpu_mesh(8)
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from dispatches_tpu.market.network import (  # noqa: E402
+    OptimizingUnitCommitment,
+    solve_uc_milp_sparse,
+    synthesize_fleet,
+)
+
+
+def main():
+    rows = []
+    for n, seed in [(50, 1), (30, 2), (70, 3)]:
+        g = synthesize_fleet(n_units=n, days=2, seed=seed)
+        ouc = OptimizingUnitCommitment(g, T=48, backend="host")
+        loads = g.da_load[:48].sum(1)
+        ren = g.da_renewables[:48].sum(1)
+        t0 = time.time()
+        cand = ouc.commit(loads, ren, improve_rounds=2)
+        t_commit = time.time() - t0
+        cost, ok = ouc._evaluate(cand[None], loads, ren)
+        t0 = time.time()
+        milp = solve_uc_milp_sparse(
+            ouc.prog,
+            {"load_total": loads, "ren_total": ren},
+            time_limit=900,
+            mip_rel_gap=1e-5,
+        )
+        rows.append(
+            {
+                "n_units": n,
+                "T": 48,
+                "seed": seed,
+                "ratio_vs_exact_milp": float(cost[0] / (milp.obj_with_offset * 1e3)),
+                "feasible": bool(ok[0]),
+                "commit_seconds": round(t_commit, 1),
+                "milp_seconds": round(time.time() - t0, 1),
+            }
+        )
+        print(json.dumps(rows[-1]), flush=True)
+    out = {"rows": rows, "contract": "ratio <= 1.01 (tests/test_uc_scale.py)"}
+    with open(os.path.join(os.path.dirname(__file__), "..", "UC_SCALE.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""365-day in-framework double-loop co-simulation (the Prescient-scale run).
+
+Reference anchor: the reference's production runs drive Prescient for a full
+year — 366 days x (1 RUC + 24 SCEDs) with the double-loop plugin attached
+(`dispatches/case_studies/renewables_case/prescient_options.py:20-29`,
+`run_double_loop_PEM.py`). Here the in-framework `ProductionCostSimulator`
+hosts the same loop natively: optimizing RUC + hourly vmapped DC-OPF SCED on
+the 5-bus system, a parametrized PEM bidder submitting DA/RT bid curves, a
+jitted tracker following the SCED dispatch, and per-solve telemetry.
+
+Writes YEAR_DOUBLELOOP.json at the repo root:
+  {"days", "sceds", "sced_unconverged", "total_cost", "participant_mwh",
+   "tracker_solves", "lmp_stats", "shortfall_hours", "wall_seconds", ...}
+
+Run:  python tools/run_year_doubleloop.py [days]
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dispatches_tpu.parallel.mesh import force_virtual_cpu_mesh
+
+force_virtual_cpu_mesh(8)
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from dispatches_tpu.market.bidder import PEMParametrizedBidder  # noqa: E402
+from dispatches_tpu.market.coordinator import DoubleLoopCoordinator  # noqa: E402
+from dispatches_tpu.market.double_loop import MultiPeriodWindPEM  # noqa: E402
+from dispatches_tpu.market.forecaster import PerfectForecaster  # noqa: E402
+from dispatches_tpu.market.model_data import RenewableGeneratorModelData  # noqa: E402
+from dispatches_tpu.market.network import (  # noqa: E402
+    ProductionCostSimulator,
+    extend_grid_to_year,
+    load_rts_format,
+)
+from dispatches_tpu.market.tracker import Tracker  # noqa: E402
+
+GEN = "309_WIND_1"
+
+
+def main(days: int = 365) -> dict:
+    t0 = time.time()
+    grid = extend_grid_to_year(load_rts_format(), days=days)
+    H = days * 24
+    # the participant is an ADDITIONAL 50 MW wind + 12.5 MW PEM plant (the
+    # run_double_loop_PEM.py shape), not one of the grid's own units; its
+    # resource follows the grid wind's year shape with its own noise
+    wind_pmax = 50.0
+    ridx = [u.name for u in grid.renewable].index("4_WIND")
+    grid_wind_cf = grid.da_renewables[:, ridx] / next(
+        u.p_max for u in grid.renewable if u.name == "4_WIND"
+    )
+    rng = np.random.default_rng(7)
+    rt_cf = np.clip(
+        grid_wind_cf * np.exp(rng.normal(0.0, 0.05, H)), 0.0, 1.0
+    )
+    da_cf = np.clip(
+        rt_cf * np.exp(rng.normal(0.0, 0.03, H)), 0.0, 1.0
+    )
+    pad = 48  # forecaster horizon slack past the last simulated hour
+    fc = PerfectForecaster({
+        f"{GEN}-DACF": np.concatenate([da_cf, da_cf[:pad]]),
+        f"{GEN}-RTCF": np.concatenate([rt_cf, rt_cf[:pad]]),
+    })
+    mp = MultiPeriodWindPEM(
+        model_data=RenewableGeneratorModelData(
+            gen_name=GEN, bus="1", p_min=0, p_max=wind_pmax, p_cost=0
+        ),
+        wind_capacity_factors=np.concatenate([rt_cf, rt_cf[:pad]]),
+        wind_pmax_mw=wind_pmax,
+        pem_pmax_mw=0.25 * wind_pmax,
+    )
+    bidder = PEMParametrizedBidder(
+        mp,
+        day_ahead_horizon=24,
+        real_time_horizon=4,
+        forecaster=fc,
+        pem_marginal_cost=25.0,
+        pem_mw=0.25 * wind_pmax,
+    )
+    tracker = Tracker(mp, tracking_horizon=4, n_tracking_hour=1)
+    coordinator = DoubleLoopCoordinator(bidder, tracker)
+
+    sim = ProductionCostSimulator(grid, participant_segments=2)
+    rows = sim.simulate(days, coordinator=coordinator)
+    wall = time.time() - t0
+
+    conv = np.array([r["SCED Converged"] for r in rows])
+    cost = np.array([r["Total Cost"] for r in rows])
+    part = np.array([r["Participant [MW]"] for r in rows])
+    short = np.array([r["Shortfall [MW]"] for r in rows])
+    lmp_cols = [k for k in rows[0] if k.startswith("LMP bus")]
+    lmps = np.array([[r[k] for k in lmp_cols] for r in rows])
+    implemented = np.asarray(tracker.get_implemented_profile())
+
+    out = {
+        "days": days,
+        "sceds": len(rows),
+        "sced_unconverged": int((~conv).sum()),
+        "total_cost": float(cost.sum()),
+        "participant_mwh": float(part.sum()),
+        "tracker_solves": int(implemented.shape[0]),
+        "tracker_mean_abs_dev_mw": float(
+            np.mean(np.abs(implemented - part[: len(implemented)]))
+        ),
+        "shortfall_hours": int((short > 1e-3).sum()),
+        "lmp_stats": {
+            "mean": float(lmps.mean()),
+            "p95": float(np.quantile(lmps, 0.95)),
+            "max": float(lmps.max()),
+        },
+        "wall_seconds": round(wall, 1),
+        "sceds_per_second": round(len(rows) / wall, 2),
+    }
+    path = os.path.join(os.path.dirname(__file__), "..", "YEAR_DOUBLELOOP.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 365)
