@@ -254,12 +254,22 @@ def main():
     _device("weekly warmup/compile", _warm)
 
     def _timed():
+        # fresh multiplicative jitter EVERY attempt: a retried timed stage
+        # must not re-submit byte-identical inputs, or the tunnel's
+        # (executable, inputs) memoization can serve a cache hit and
+        # inflate solves/sec (the round-2 723k-"solves/sec" failure). The
+        # jittered inputs are RETURNED so the CPU accuracy baseline solves
+        # the same LPs (otherwise the jitter would pollute rel_err).
+        jit_lmps = lmps * np.float32(1.0 + rng.uniform(-1e-4, 1e-4))
         t0 = time.perf_counter()
-        obj, conv, iters = fn(jnp.asarray(lmps), jnp.asarray(cfs))
+        obj, conv, iters = fn(jnp.asarray(jit_lmps), jnp.asarray(cfs))
         obj = np.asarray(obj)
-        return obj, np.asarray(conv), np.asarray(iters), time.perf_counter() - t0
+        return (
+            obj, np.asarray(conv), np.asarray(iters),
+            time.perf_counter() - t0, jit_lmps,
+        )
 
-    obj, conv, iters, dt = _device("weekly timed batch", _timed)
+    obj, conv, iters, dt, lmps_used = _device("weekly timed batch", _timed)
     solves_per_sec = B / dt
     conv_frac = float(np.mean(conv))
     med_iters = float(np.median(iters))
@@ -288,7 +298,7 @@ def main():
     cpu_lps = [
         prog.instantiate(
             {
-                "lmp": jnp.asarray(lmps[k], jnp.float64),
+                "lmp": jnp.asarray(lmps_used[k], jnp.float64),
                 "wind_cf": jnp.asarray(cfs[k], jnp.float64),
             }
         )
@@ -333,12 +343,6 @@ def main():
     ylmp = np.tile(lmp_weeks.reshape(-1), 2)[:Ty] * rng.uniform(0.95, 1.05, Ty)
     ycf = np.tile(cf_weeks.reshape(-1), 2)[:Ty]
 
-    # HiGHS year objective for the SAME fresh inputs: the accuracy gate
-    # (~25 s on host; runs while nothing is queued on the chip)
-    yp64 = {"lmp": jnp.asarray(ylmp, jnp.float64),
-            "wind_cf": jnp.asarray(ycf, jnp.float64)}
-    yref = solve_lp_scipy_sparse(yprog, yp64)
-
     # single-year row: 8-slab SPIKE decomposition, f32 data + f32 factor
     # with full-precision-in-dtype refinement; gated on objective error
     # against HiGHS, not just `converged`
@@ -357,18 +361,32 @@ def main():
     _device("year warmup/compile", _year_warm)
 
     def _year_timed():
+        # fresh jitter per attempt (see _timed); returned so the HiGHS
+        # error below is computed against the same inputs
+        jfac = np.float32(1 + rng.uniform(0.5e-6, 5e-6))
         yblp2 = ymeta.instantiate(
-            {"lmp": yparams["lmp"] * np.float32(1 + 1e-6),
-             "wind_cf": yparams["wind_cf"]},
+            {"lmp": yparams["lmp"] * jfac, "wind_cf": yparams["wind_cf"]},
             dtype=jnp.float32,
         )
         t0 = time.perf_counter()
         ysol = solve_lp_banded(ymeta, yblp2, **ykw)
         yobj = float(np.asarray(ysol.obj))
-        return yobj, bool(np.asarray(ysol.converged)), time.perf_counter() - t0
+        return (
+            yobj, bool(np.asarray(ysol.converged)),
+            time.perf_counter() - t0, float(jfac),
+        )
 
-    yobj, yconv, ydt = _device("year timed solve", _year_timed)
-    yerr = abs(yobj - yref.obj_with_offset) / max(1.0, abs(yref.obj_with_offset))
+    yobj, yconv, ydt, yjfac = _device("year timed solve", _year_timed)
+    # HiGHS year objective for the SAME (jittered) inputs: the accuracy
+    # gate (~25 s on host, after the chip work is done)
+    yref = solve_lp_scipy_sparse(
+        yprog,
+        {"lmp": jnp.asarray(ylmp * yjfac, jnp.float64),
+         "wind_cf": jnp.asarray(ycf, jnp.float64)},
+    )
+    yerr = abs(yobj - yref.obj_with_offset) / max(
+        1.0, abs(yref.obj_with_offset)
+    )
     # f32 year floor is ~1% (objective is a revenue-cost difference with
     # heavy cancellation); 5e-2 is the round-3 contract for pure f32
     yok = yconv and yerr < 5e-2
@@ -404,25 +422,33 @@ def main():
     _device("year-batch warmup/compile", _ybatch_warm)
 
     def _ybatch_timed():
-        blp_b = _instantiate_batch(yscales)
+        # fresh jitter per attempt (see _timed); actual scales returned
+        # for the accuracy spot-check
+        scales = yscales * np.float32(1.0 + rng.uniform(-1e-5, 1e-5))
+        blp_b = _instantiate_batch(scales)
         t0 = time.perf_counter()
         sol = solve_lp_banded_batch(ybmeta, blp_b, **ybkw)
         objs = np.asarray(sol.obj)
-        return objs, np.asarray(sol.converged), time.perf_counter() - t0
+        return objs, np.asarray(sol.converged), time.perf_counter() - t0, scales
 
-    ybobjs, ybconv, ybdt = _device("year-batch timed solve", _ybatch_timed)
+    ybobjs, ybconv, ybdt, yb_scales = _device(
+        "year-batch timed solve", _ybatch_timed
+    )
     yb_conv_frac = float(np.mean(ybconv))
     scen_years_per_min = By / ybdt * 60.0
     t500 = 500.0 / (By / ybdt)  # projected single-chip 500-scenario time
     # accuracy spot-check: scenario 0 vs HiGHS on the same scaled inputs
     yb_ref = solve_lp_scipy_sparse(
         yprog,
-        {"lmp": jnp.asarray(yscales[0] * ylmp, jnp.float64),
+        {"lmp": jnp.asarray(yb_scales[0] * ylmp, jnp.float64),
          "wind_cf": jnp.asarray(ycf, jnp.float64)},
     )
     yb_err = abs(float(ybobjs[0]) - yb_ref.obj_with_offset) / max(
         1.0, abs(yb_ref.obj_with_offset)
     )
+    # north-star row gate: same contract as the other rows — throughput
+    # for unconverged or wrong solves is not a benchmark
+    yb_ok = yb_conv_frac >= 0.99 and yb_err < 5e-2
 
     result = {
         "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
@@ -440,6 +466,10 @@ def main():
     }
     if not yok:
         result["metric"] = "YEAR GATE FAILED (see fields): " + result["metric"]
+    if not yb_ok:
+        result["metric"] = (
+            "YEAR-BATCH GATE FAILED (see fields): " + result["metric"]
+        )
 
     # timestamped local success artifact: a capture-time outage must not
     # erase a measured number (round-3 verdict, Weak #3)
@@ -469,6 +499,7 @@ def main():
                         "converged_frac": yb_conv_frac,
                         "scen0_rel_err_vs_highs": yb_err,
                         "projected_500_scenarios_min": t500 / 60.0,
+                        "gate_ok": yb_ok,
                     },
                     "stage_times": _DIAG["stage_times"],
                     "total_seconds": time.perf_counter() - t_start,

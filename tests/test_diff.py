@@ -194,3 +194,95 @@ def test_direct_lpdata_gradients_small_lp():
             - float(val(*[jnp.asarray(a) for a in am]))
         ) / (2 * h)
         assert float(np.asarray(g[k])[idx]) == pytest.approx(fd, rel=5e-4, abs=1e-6)
+
+
+class TestBandedEnvelope:
+    """`optimal_value_banded` — year-path differentiable optimal value
+    (BASELINE.md north-star: year sweeps WITH design gradients). The
+    Lagrangian-through-instantiate construction must agree with the dense
+    `optimal_value` envelope, and each coordinate must be a valid
+    subgradient of the piecewise-linear V(lmp) (at degenerate hours the
+    IPM's analytic-center x differs from HiGHS's vertex, so agreement with
+    a one-sided slope is NOT required — membership in [left, right] is)."""
+
+    def _case(self, T=96):
+        from dispatches_tpu.case_studies.renewables import params as P
+        from dispatches_tpu.case_studies.renewables.pricetaker import (
+            HybridDesign,
+            build_pricetaker,
+        )
+        from dispatches_tpu.solvers.structured import extract_time_structure
+
+        D = P.load_rts303()
+        design = HybridDesign(
+            T=T, with_battery=True, with_pem=True, design_opt=True,
+            h2_price_per_kg=2.5, initial_soc_fixed=None,
+        )
+        prog, _ = build_pricetaker(design)
+        lmp = jnp.asarray(D["da_lmp"][:T])
+        cf = jnp.asarray(D["da_wind_cf"][:T])
+        meta = extract_time_structure(prog, T, block_hours=24)
+        return prog, meta, lmp, cf
+
+    def test_matches_dense_envelope(self):
+        from dispatches_tpu.solvers.diff import optimal_value
+        from dispatches_tpu.solvers.structured import optimal_value_banded
+
+        prog, meta, lmp, cf = self._case()
+        vb, gb = jax.value_and_grad(
+            lambda lm: optimal_value_banded(
+                meta, {"lmp": lm, "wind_cf": cf}, tol=1e-10, max_iter=80
+            )
+        )(lmp)
+        vd, gd = jax.value_and_grad(
+            lambda lm: optimal_value(
+                prog, {"lmp": lm, "wind_cf": cf}, tol=1e-10, max_iter=80
+            )
+        )(lmp)
+        assert float(vb) == pytest.approx(float(vd), rel=1e-5)
+        np.testing.assert_allclose(np.asarray(gb), np.asarray(gd), atol=1e-4)
+
+    def test_subgradient_validity_vs_highs_slopes(self):
+        from dispatches_tpu.solvers.reference import solve_lp_scipy_sparse
+        from dispatches_tpu.solvers.structured import optimal_value_banded
+
+        prog, meta, lmp, cf = self._case()
+        g = jax.grad(
+            lambda lm: optimal_value_banded(
+                meta, {"lmp": lm, "wind_cf": cf}, tol=1e-10, max_iter=80
+            )
+        )(lmp)
+
+        def fh(lm):
+            # optimal_value is in the model's (maximize) sense
+            return prog.obj_sense * solve_lp_scipy_sparse(
+                prog, {"lmp": lm, "wind_cf": cf}
+            ).obj_with_offset
+
+        base = fh(lmp)
+        eps = 1e-3
+        for h in (10, 30, 60):
+            right = (fh(lmp.at[h].add(eps)) - base) / eps
+            left = (base - fh(lmp.at[h].add(-eps))) / eps
+            lo, hi = min(left, right) - 1e-3, max(left, right) + 1e-3
+            assert lo <= float(g[h]) <= hi, (h, left, right, float(g[h]))
+
+    def test_vmapped_scenario_batch_gradients(self):
+        """One vmap+grad call prices B LMP scenarios of the same design
+        program and returns per-scenario gradients — the north-star sweep
+        shape."""
+        from dispatches_tpu.solvers.structured import optimal_value_banded
+
+        prog, meta, lmp, cf = self._case(T=48)
+        scales = jnp.asarray([0.9, 1.0, 1.2])
+        lmps = scales[:, None] * lmp[None, :48]
+
+        def value(lm):
+            return optimal_value_banded(
+                meta, {"lmp": lm, "wind_cf": cf[:48]}, tol=1e-9, max_iter=60
+            )
+
+        vals, grads = jax.vmap(jax.value_and_grad(value))(lmps)
+        assert vals.shape == (3,) and grads.shape == (3, 48)
+        # higher LMPs cannot make the optimal NPV worse
+        assert float(vals[2]) >= float(vals[0]) - 1e-6

@@ -261,6 +261,83 @@ class TestMixedPrecision:
         assert e3 < e0 * 1e-2, (e0, e3)
 
 
+class TestBatchedYearSolves:
+    """`solve_lp_banded_batch` — the scenario-batched year-solve axis
+    (BASELINE.md north-star: 8,760 h x hundreds of LMP scenarios, one
+    shared banded structure). Validated here at reduced T for suite speed;
+    the bench year-batch row runs the full 8,760-h version on the chip."""
+
+    def test_batch_matches_single_solves_and_highs(self):
+        import jax
+
+        from dispatches_tpu.solvers.structured import solve_lp_banded_batch
+
+        T, B = 96, 4
+        prog, p = _flagship(T)
+        meta = extract_time_structure(prog, T, block_hours=24)
+        scales = np.linspace(0.85, 1.3, B)
+        lmps = jnp.asarray(scales[:, None] * np.asarray(p["lmp"])[None, :])
+        blp_b = jax.vmap(
+            lambda lm: meta.instantiate({"lmp": lm, "wind_cf": p["wind_cf"]})
+        )(lmps)
+        sol = solve_lp_banded_batch(meta, blp_b, tol=1e-9, max_iter=60)
+        assert np.asarray(sol.converged).all()
+        assert sol.obj.shape == (B,)
+        # rel 1e-5, not bitwise: under vmap the while_loop runs until the
+        # SLOWEST lane converges, so already-converged lanes keep stepping
+        # (best-iterate tracking bounds the drift but does not zero it)
+        for k in (0, B - 1):
+            single = solve_lp_banded(
+                meta,
+                meta.instantiate({"lmp": lmps[k], "wind_cf": p["wind_cf"]}),
+                tol=1e-9,
+                max_iter=60,
+            )
+            assert float(sol.obj[k]) == pytest.approx(float(single.obj), rel=1e-5)
+        # ... and the first also matches HiGHS on the same inputs
+        ref0 = solve_lp_scipy_sparse(
+            prog, {"lmp": lmps[0], "wind_cf": p["wind_cf"]}
+        )
+        assert float(sol.obj[0]) == pytest.approx(ref0.obj_with_offset, rel=1e-5)
+
+    def test_batch_sharded_one_scenario_per_device(self):
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dispatches_tpu.parallel.mesh import scenario_mesh
+        from dispatches_tpu.solvers.structured import solve_lp_banded_batch
+
+        T, B = 48, 8
+        prog, p = _flagship(T)
+        meta = extract_time_structure(prog, T, block_hours=24)
+        lmps = jnp.asarray(
+            np.linspace(0.8, 1.4, B)[:, None] * np.asarray(p["lmp"])[None, :]
+        )
+        blp_b = _jax.vmap(
+            lambda lm: meta.instantiate({"lmp": lm, "wind_cf": p["wind_cf"]})
+        )(lmps)
+        ref = solve_lp_banded_batch(meta, blp_b, tol=1e-9)
+        mesh = scenario_mesh(8, axis="scenario")
+        sh = NamedSharding(mesh, PartitionSpec("scenario"))
+        sol = solve_lp_banded_batch(meta, blp_b, sharding=sh, tol=1e-9)
+        assert np.asarray(sol.converged).all()
+        # sharded reductions reorder floating-point sums, so a degenerate
+        # scenario may settle on a marginally different near-optimal point
+        np.testing.assert_allclose(
+            np.asarray(sol.obj), np.asarray(ref.obj), rtol=1e-5
+        )
+
+    def test_batch_rejects_mesh_kwarg(self):
+        from dispatches_tpu.solvers.structured import solve_lp_banded_batch
+
+        T = 48
+        prog, p = _flagship(T)
+        meta = extract_time_structure(prog, T, block_hours=24)
+        blp = meta.instantiate(p)
+        with pytest.raises(ValueError, match="sharding"):
+            solve_lp_banded_batch(meta, blp, mesh=object())
+
+
 def test_non_banded_model_raises():
     """A constraint coupling non-adjacent hours across blocks is detected."""
     from dispatches_tpu.core.model import Model
