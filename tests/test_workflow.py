@@ -120,6 +120,28 @@ class TestRunners:
         assert rc == 0
         assert "NPV" in capsys.readouterr().out
 
+    def test_year_sweep_runner_checkpoints(self, tmp_path):
+        """North-star entry point at reduced horizon: scenario-batched
+        banded design solves (mixed precision), NPVs recorded, resumed runs
+        skip solved scenarios."""
+        from dispatches_tpu.workflow.runners import run_year_sweep
+
+        store = tmp_path / "year.bin"
+        out = run_year_sweep(
+            scenarios=3, batch=2, hours=192, h2_price=2.5,
+            store_path=str(store), verbose=False,
+        )
+        assert len(out) == 3
+        assert all(r["converged"] for r in out)
+        # higher LMP scale -> NPV no worse (design can always not change)
+        by_scale = sorted(out, key=lambda r: r["lmp_scale"])
+        assert by_scale[-1]["NPV"] >= by_scale[0]["NPV"] - 1e-3
+        out2 = run_year_sweep(
+            scenarios=3, batch=2, hours=192, h2_price=2.5,
+            store_path=str(store), verbose=False,
+        )
+        assert out2 == []
+
 
 class TestTelemetry:
     def test_observe_and_summary(self):
