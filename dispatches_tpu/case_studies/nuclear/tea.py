@@ -1,0 +1,90 @@
+"""Traditional (dispatch-free) TEA for nuclear + PEM hybridization.
+
+Parity with reference `nuclear_case/report/traditional_tea.py:20-74`
+(`ne_traditional_tea`): a closed-form annualized-NPV model for adding an
+electrolyzer to an existing baseload nuclear generator — capacity-factor
+energy accounting at the average LMP, straight-line depreciation, a
+max(0, .) corporate tax, and an annuity-factor capital charge. The
+reference's `run_exhaustive_enumeration` (:77-110) evaluates a 6x10
+(h2 price x PEM ratio) grid in a Python double loop; here the model is a
+jnp-vectorized function of arrays, so the whole sensitivity grid is ONE
+broadcast evaluation (and differentiable — d NPV / d price / d ratio come
+free, where the reference can only tabulate).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# reference constants (`traditional_tea.py:44-58`)
+NPP_CAPACITY_MW = 400.0
+AVG_LMP = 22.09341  # DA LMP at bus Attlee, $/MWh
+H2_PROD_RATE_KG_PER_MWH = 20.0
+NUM_HOURS = 8784.0
+DISCOUNT_RATE = 0.08
+PLANT_LIFE_YRS = 30
+TAX_RATE = 0.2
+VOM_PEM = 0.0
+FOM_NPP_PER_MW_YR = 120.0 * 1000.0
+
+
+def ne_traditional_tea(
+    npp_pem_ratio=0.5,
+    pem_cap_factor=0.75,
+    h2_selling_price=0.75,
+    pem_capex=1200.0,
+    vom_npp=2.3,
+):
+    """Annualized NPV, electricity revenue, H2 revenue — broadcasting over
+    any array-shaped inputs (`traditional_tea.py:20-74` semantics, same
+    constants; returns a tuple of jnp arrays)."""
+    ratio = jnp.asarray(npp_pem_ratio, jnp.result_type(float))
+    cap_f = jnp.asarray(pem_cap_factor)
+    h2_price = jnp.asarray(h2_selling_price)
+    capex_per_kw = jnp.asarray(pem_capex)
+
+    pem_capacity = NPP_CAPACITY_MW * ratio
+    capex_per_mw = capex_per_kw * 1000.0
+    fom_pem = 0.03 * capex_per_mw
+    annuity = (1.0 - (1.0 + DISCOUNT_RATE) ** (-PLANT_LIFE_YRS)) / DISCOUNT_RATE
+
+    h2_produced = pem_capacity * H2_PROD_RATE_KG_PER_MWH * NUM_HOURS * cap_f
+    electricity_sold = NPP_CAPACITY_MW * NUM_HOURS - pem_capacity * NUM_HOURS * cap_f
+    h2_revenue = h2_produced * h2_price
+    elec_revenue = electricity_sold * AVG_LMP
+    total_vom = (
+        NPP_CAPACITY_MW * NUM_HOURS * vom_npp
+        + pem_capacity * NUM_HOURS * VOM_PEM
+    )
+    capex = capex_per_mw * pem_capacity
+    total_fom = fom_pem * pem_capacity + FOM_NPP_PER_MW_YR * NPP_CAPACITY_MW
+    depreciation = capex / PLANT_LIFE_YRS
+    tax = jnp.maximum(
+        0.0,
+        TAX_RATE * (h2_revenue + elec_revenue - total_vom - total_fom - depreciation),
+    )
+    net_profit = h2_revenue + elec_revenue - total_vom - total_fom - tax
+    npv = net_profit - capex / annuity
+    return npv, elec_revenue, h2_revenue
+
+
+def traditional_tea_enumeration(
+    h2_prices=(0.75, 1.0, 1.25, 1.5, 1.75, 2.0),
+    pem_ratios=tuple(i / 100 for i in range(5, 51, 5)),
+    pem_capex=400.0,
+):
+    """The reference's exhaustive sensitivity sweep
+    (`traditional_tea.py:77-110`) as one broadcast evaluation: returns a
+    dict of (len(h2_prices), len(pem_ratios)) arrays in $M, matching the
+    reference's JSON units (values / 1e6)."""
+    hp = jnp.asarray(h2_prices)[:, None]
+    pr = jnp.asarray(pem_ratios)[None, :]
+    npv, elec_rev, h2_rev = ne_traditional_tea(
+        npp_pem_ratio=pr, h2_selling_price=hp, pem_capex=pem_capex
+    )
+    return {
+        "h2_price": jnp.asarray(h2_prices),
+        "pem_cap": jnp.asarray(pem_ratios),
+        "net_npv": npv / 1e6,
+        "elec_rev": elec_rev / 1e6,
+        "h2_rev": h2_rev / 1e6,
+    }

@@ -55,6 +55,10 @@ class HybridDesign:
     tank_size_mol: float = P.FIXED_TANK_SIZE * P.H2_MOLS_PER_KG
     h2_price_per_kg: float = P.H2_PRICE_PER_KG
     initial_soc_fixed: Optional[float] = None  # None -> free (periodic only)
+    # battery energy/power ratio (the reference's `--duration` sweep axis,
+    # `run_pricetaker_battery_ratio_size.py:41-46`); enters both the SoC
+    # dynamics and the $/kWh leg of the battery capex
+    battery_duration_hrs: float = P.BATTERY_DURATION_HRS
 
 
 def build_hybrid(design: HybridDesign):
@@ -88,7 +92,7 @@ def build_hybrid(design: HybridDesign):
         battery = BatteryStorage(
             m,
             T,
-            duration=P.BATTERY_DURATION_HRS,
+            duration=design.battery_duration_hrs,
             charging_eta=P.BATTERY_EFF,
             discharging_eta=P.BATTERY_EFF,
             degradation_rate=P.BATTERY_DEGRADATION,
@@ -198,7 +202,8 @@ def _npv_objective(m: Model, units, design: HybridDesign, T: int, h2_price=None)
         capex = capex + P.WIND_CAP_COST * re.system_capacity
     if "battery" in units:
         capex = capex + (
-            P.BATT_CAP_COST_KW + P.BATT_CAP_COST_KWH * P.BATTERY_DURATION_HRS
+            P.BATT_CAP_COST_KW
+            + P.BATT_CAP_COST_KWH * design.battery_duration_hrs
         ) * units["battery"].nameplate_power
     if "pem" in units:
         capex = capex + P.PEM_CAP_COST * units["pem_cap"]
@@ -271,6 +276,7 @@ def wind_battery_optimize(
     wind_mw: float = P.FIXED_WIND_MW,
     design_opt: bool = True,
     extant_wind: bool = True,
+    battery_duration_hrs: float = P.BATTERY_DURATION_HRS,
     **solver_kw,
 ):
     """Parity driver for `wind_battery_optimize` (`wind_battery_LMP.py:172`)."""
@@ -282,6 +288,7 @@ def wind_battery_optimize(
         design_opt=design_opt,
         extant_wind=extant_wind,
         initial_soc_fixed=0.0,  # `wind_battery_LMP.py:206`
+        battery_duration_hrs=battery_duration_hrs,
     )
     prog, units = build_pricetaker(design)
     p = {

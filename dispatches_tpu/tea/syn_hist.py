@@ -1,0 +1,89 @@
+"""Synthetic-history integration: trained price model -> clustered LMP sets.
+
+Parity with reference `util/syn_hist_integration.py` (`SynHist_integration`):
+the reference loads a pickled RAVEN ARMA ROM and returns a nested dict of
+per-year representative-day LMPs with cluster weights and day maps —
+``weights_days[year][cluster]``, ``LMP[year][cluster][hour]`` (1-based
+cluster/hour keys), ``cluster_map[year][cluster]`` — consumed by the
+price-taker workflow. Here the trained model is the framework's own
+`tea/arma.py` ARMAModel (serialized to JSON instead of a RAVEN pickle),
+sampling runs as a jitted scan, and the per-year day clustering is the
+device k-means from `surrogates/clustering.py` — generation, clustering
+and weighting in one in-framework pipeline instead of three external
+tools (RAVEN + TEAL + tslearn).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..surrogates.clustering import kmeans
+from .arma import ARMAModel, generate
+
+
+def save_arma(model: ARMAModel, path: str) -> None:
+    """Serialize a trained ARMAModel to JSON (the framework's analogue of
+    RAVEN's pickledROM artifact — portable, human-readable, no pickle)."""
+    with open(path, "w") as f:
+        json.dump(
+            {k: np.asarray(v).tolist() for k, v in model._asdict().items()},
+            f,
+        )
+
+
+def load_arma(path: str) -> ARMAModel:
+    with open(path) as f:
+        d = json.load(f)
+    return ARMAModel(**{k: jnp.asarray(v) for k, v in d.items()})
+
+
+class SynHistIntegration:
+    """Load a saved ARMA price model and emit workflow-shaped synthetic
+    histories (`syn_hist_integration.py:36-127` surface)."""
+
+    def __init__(self, target_file: str):
+        self.target_file = target_file
+        self.model = load_arma(target_file)
+
+    def generate_synthetic_history(
+        self,
+        signal_name: str,
+        set_years,
+        n_clusters: int = 20,
+        hours_per_day: int = 24,
+        days_per_year: int = 365,
+        seed: int = 0,
+    ) -> dict:
+        """One ARMA realization per requested year, clustered into
+        `n_clusters` representative days. Returns the reference's nested
+        dict shape: 1-based cluster ids and hours, per-cluster day counts
+        as weights, and the day->cluster membership map."""
+        if signal_name != "LMP":
+            raise KeyError(
+                f"signal name {signal_name!r} not in this model (signals: "
+                "['LMP'])"
+            )
+        T = days_per_year * hours_per_day
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(set_years) + 1)
+        out = {"weights_days": {}, "LMP": {}, "cluster_map": {}}
+        for yi, year in enumerate(set_years):
+            series = np.asarray(generate(self.model, T, keys[yi + 1])[0])
+            days = series.reshape(days_per_year, hours_per_day)
+            res = kmeans(jnp.asarray(days), n_clusters, n_iter=50, seed=seed)
+            labels = np.asarray(res.labels)
+            centers = np.asarray(res.centers)
+            out["weights_days"][year] = {}
+            out["cluster_map"][year] = {}
+            out["LMP"][year] = {}
+            for c in range(n_clusters):
+                members = np.where(labels == c)[0]
+                out["weights_days"][year][c + 1] = int(members.size)
+                out["cluster_map"][year][c + 1] = members.tolist()
+                out["LMP"][year][c + 1] = {
+                    h + 1: float(centers[c, h]) for h in range(hours_per_day)
+                }
+        return out

@@ -29,6 +29,20 @@ from .options import SimulationOptions
 TOPOLOGIES = ("wind_battery", "wind_pem", "wind_pem_tank_turbine")
 
 
+def _point_key(*vals) -> int:
+    """Stable ResultStore key derived from the sweep point's CONTENT (not
+    its loop index): re-running a sweep with different grids against the
+    same store must re-solve new points instead of silently skipping them
+    because an index happens to be occupied."""
+    import hashlib
+
+    digest = hashlib.blake2s(
+        repr(tuple(v if isinstance(v, str) else float(v) for v in vals)).encode(),
+        digest_size=8,
+    ).digest()
+    return int.from_bytes(digest, "big") >> 1  # non-negative int64
+
+
 def run_pricetaker(
     topology: str = "wind_pem",
     hours: int = 168,
@@ -51,7 +65,8 @@ def run_pricetaker(
 
     out = []
     for i, h2 in enumerate(h2_prices):
-        if i in done:
+        key = _point_key(topology, hours, h2)
+        if key in done:
             if verbose:
                 print(f"[{i}] h2=${h2}/kg: checkpointed, skipping")
             continue
@@ -77,7 +92,7 @@ def run_pricetaker(
         out.append(rec)
         if store:
             store.append(
-                i,
+                key,
                 [h2, rec["NPV"], rec["annual_revenue"], rec["pem_kw"], rec["batt_kw"]],
             )
         if verbose:
@@ -89,6 +104,65 @@ def run_pricetaker(
                 f"{st.get('converged_frac', float('nan')):.3f}, "
                 f"iters {it.get('median', '?')}, "
                 f"gap {st.get('gap', {}).get('max', float('nan')):.1e}"
+            )
+    return out
+
+
+def run_battery_ratio_sweep(
+    ratios=(0.1, 0.25, 0.5),
+    durations=(2, 4, 8),
+    hours: int = 168,
+    wind_mw: float = None,
+    store_path: Optional[str] = None,
+    verbose: bool = True,
+):
+    """Battery sizing sweep over (capacity ratio, duration-hours) — the
+    reference's `run_pricetaker_battery_ratio_size.py` (one CBC subprocess
+    per grid point there; one checkpointed in-process solve per point
+    here). Battery power is fixed at ratio x wind capacity; duration sets
+    both the SoC dynamics and the $/kWh capex leg."""
+    from ..case_studies.renewables import params as P
+    from ..case_studies.renewables.pricetaker import wind_battery_optimize
+
+    data = P.load_rts303()
+    if wind_mw is None:
+        wind_mw = P.FIXED_WIND_MW
+    grid = [(r, d) for r in ratios for d in durations]
+    store = ResultStore(store_path) if store_path else None
+    done = set(store.keys()) if store else set()
+    out = []
+    for i, (ratio, dur) in enumerate(grid):
+        key = _point_key(ratio, dur, hours, wind_mw)
+        if key in done:
+            if verbose:
+                print(f"[{i}] ratio={ratio} dur={dur}h: checkpointed, skipping")
+            continue
+        res = wind_battery_optimize(
+            hours,
+            data["da_lmp"],
+            data["da_wind_cf"],
+            batt_mw=ratio * wind_mw,
+            wind_mw=wind_mw,
+            design_opt=False,
+            battery_duration_hrs=float(dur),
+        )
+        rec = {
+            "battery_ratio": ratio,
+            "duration_hrs": dur,
+            "batt_mw": ratio * wind_mw,
+            "NPV": res["NPV"],
+            "annual_revenue": res["annual_revenue"],
+            "converged": bool(res["converged"]),
+        }
+        out.append(rec)
+        if store and rec["converged"]:
+            store.append(
+                key, [ratio, float(dur), rec["NPV"], rec["annual_revenue"]]
+            )
+        if verbose:
+            print(
+                f"[{i}] ratio={ratio} dur={dur}h: NPV ${rec['NPV']:.3e} "
+                f"rev ${rec['annual_revenue']:.3e}"
             )
     return out
 
@@ -163,7 +237,11 @@ def run_year_sweep(
     done = set(store.keys()) if store else set()
 
     out = []
-    pending = [k for k in range(scenarios) if k not in done]
+    skeys = {
+        k: _point_key("yearsweep", seed, k, hours, h2_price)
+        for k in range(scenarios)
+    }
+    pending = [k for k in range(scenarios) if skeys[k] not in done]
     if verbose and len(pending) < scenarios:
         print(f"{scenarios - len(pending)} scenarios checkpointed, skipping")
     for lo in range(0, len(pending), batch):
@@ -199,7 +277,7 @@ def run_year_sweep(
             # stay re-solvable on resume (and its NPV must not be cached
             # as an answer)
             if store and rec["converged"]:
-                store.append(k, [rec["lmp_scale"], rec["NPV"], 1.0])
+                store.append(skeys[k], [rec["lmp_scale"], rec["NPV"], 1.0])
         if verbose:
             print(
                 f"[{todo[0]}..{todo[-1]}] {len(todo)} year-LPs: "
@@ -300,6 +378,14 @@ def main(argv=None):
     dl.add_argument("--config", default=None, help="SimulationOptions JSON")
     dl.add_argument("--out", default=None, help="results CSV path")
 
+    bs = sub.add_parser(
+        "battsweep", help="battery ratio x duration sizing sweep"
+    )
+    bs.add_argument("--ratio", type=float, nargs="+", default=[0.1, 0.25, 0.5])
+    bs.add_argument("--duration", type=int, nargs="+", default=[2, 4, 8])
+    bs.add_argument("--hours", type=int, default=168)
+    bs.add_argument("--out", default=None, help="ResultStore checkpoint path")
+
     ys = sub.add_parser(
         "yearsweep", help="year-scale LMP-scenario design sweep (north-star)"
     )
@@ -342,6 +428,13 @@ def main(argv=None):
         )
         opts.num_days = args.days
         run_double_loop(opts, out_csv=args.out)
+    elif args.cmd == "battsweep":
+        run_battery_ratio_sweep(
+            ratios=args.ratio,
+            durations=args.duration,
+            hours=args.hours,
+            store_path=args.out,
+        )
     elif args.cmd == "yearsweep":
         run_year_sweep(
             scenarios=args.scenarios,

@@ -116,3 +116,78 @@ def test_structured_ipm_solves_the_lp_pdhg_could_not():
     sol = solve_horizon(prog, p, T, block_hours=24, tol=1e-10)
     assert bool(sol.converged)
     assert float(sol.obj) == pytest.approx(float(ref.obj), rel=1e-6)
+
+
+class TestSynHistIntegration:
+    """`util/syn_hist_integration.py` parity: saved ARMA model -> sampled
+    multi-year synthetic histories -> per-year representative-day clusters
+    in the reference's nested dict shape."""
+
+    def test_save_load_roundtrip(self, tmp_path):
+        from dispatches_tpu.tea.arma import fit_arma, generate
+        from dispatches_tpu.tea.syn_hist import load_arma, save_arma
+
+        rng = np.random.default_rng(0)
+        t = np.arange(24 * 60)
+        series = (
+            25.0
+            + 8.0 * np.sin(2 * np.pi * t / 24.0)
+            + rng.normal(0, 2.0, t.size)
+        )
+        model = fit_arma(series, p=2, q=1, fourier_periods=(24.0,))
+        path = tmp_path / "lmp_arma.json"
+        save_arma(model, str(path))
+        back = load_arma(str(path))
+        for a, b in zip(model, back):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        # loaded model samples identically under the same key
+        import jax
+
+        k = jax.random.PRNGKey(7)
+        np.testing.assert_allclose(
+            np.asarray(generate(model, 48, k)),
+            np.asarray(generate(back, 48, k)),
+        )
+
+    def test_generate_synthetic_history_shape(self, tmp_path):
+        from dispatches_tpu.tea.arma import fit_arma
+        from dispatches_tpu.tea.syn_hist import SynHistIntegration, save_arma
+
+        rng = np.random.default_rng(1)
+        t = np.arange(24 * 90)
+        series = 30.0 + 10.0 * np.sin(2 * np.pi * t / 24.0) + rng.normal(0, 3, t.size)
+        path = tmp_path / "m.json"
+        save_arma(fit_arma(series, fourier_periods=(24.0,)), str(path))
+
+        sh = SynHistIntegration(str(path))
+        years = [2025, 2026]
+        out = sh.generate_synthetic_history(
+            "LMP", years, n_clusters=5, days_per_year=60
+        )
+        assert set(out) == {"weights_days", "LMP", "cluster_map"}
+        for year in years:
+            # 1-based cluster keys; weights sum to the year's day count
+            assert set(out["weights_days"][year]) == set(range(1, 6))
+            assert sum(out["weights_days"][year].values()) == 60
+            # every day appears exactly once across the cluster map
+            all_days = sorted(
+                d for ds in out["cluster_map"][year].values() for d in ds
+            )
+            assert all_days == list(range(60))
+            # 1-based hour keys, 24 per representative day
+            assert set(out["LMP"][year][1]) == set(range(1, 25))
+        # distinct years sample distinct histories
+        assert out["LMP"][2025][1] != out["LMP"][2026][1]
+
+    def test_unknown_signal_raises(self, tmp_path):
+        from dispatches_tpu.tea.arma import fit_arma
+        from dispatches_tpu.tea.syn_hist import SynHistIntegration, save_arma
+
+        rng = np.random.default_rng(2)
+        series = 20.0 + rng.normal(0, 1, 24 * 30)
+        path = tmp_path / "m.json"
+        save_arma(fit_arma(series, fourier_periods=(24.0,)), str(path))
+        with pytest.raises(KeyError, match="not in this model"):
+            SynHistIntegration(str(path)).generate_synthetic_history(
+                "WIND", [2025]
+            )

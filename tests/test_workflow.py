@@ -120,6 +120,29 @@ class TestRunners:
         assert rc == 0
         assert "NPV" in capsys.readouterr().out
 
+    def test_battery_ratio_sweep(self, tmp_path):
+        """`run_pricetaker_battery_ratio_size.py` parity: (ratio, duration)
+        grid with checkpoint skip; duration changes the answer (it enters
+        SoC dynamics and the $/kWh capex leg)."""
+        from dispatches_tpu.workflow.runners import run_battery_ratio_sweep
+
+        store = tmp_path / "batt.bin"
+        out = run_battery_ratio_sweep(
+            ratios=[0.1, 0.3], durations=[2, 6], hours=48,
+            store_path=str(store), verbose=False,
+        )
+        assert len(out) == 4
+        assert all(r["converged"] for r in out)
+        assert all(np.isfinite(r["NPV"]) for r in out)
+        d2 = next(r for r in out if r["battery_ratio"] == 0.3 and r["duration_hrs"] == 2)
+        d6 = next(r for r in out if r["battery_ratio"] == 0.3 and r["duration_hrs"] == 6)
+        assert d2["NPV"] != d6["NPV"]
+        out2 = run_battery_ratio_sweep(
+            ratios=[0.1, 0.3], durations=[2, 6], hours=48,
+            store_path=str(store), verbose=False,
+        )
+        assert out2 == []
+
     def test_year_sweep_runner_checkpoints(self, tmp_path):
         """North-star entry point at reduced horizon: scenario-batched
         banded design solves (mixed precision), NPVs recorded, resumed runs
