@@ -237,8 +237,19 @@ def run_year_sweep(
     done = set(store.keys()) if store else set()
 
     out = []
+    # key on the scenario's CONTENT (its LMP scale) plus everything that
+    # changes the answer (horizon, H2 price, dtype, precision mode) — NOT
+    # on (seed, index): re-running with a different scale range / dtype /
+    # mixed_precision against the same store must re-solve, not skip
     skeys = {
-        k: _point_key("yearsweep", seed, k, hours, h2_price)
+        k: _point_key(
+            "yearsweep",
+            float(scales[k]),
+            hours,
+            h2_price,
+            str(jdtype),
+            1.0 if (mixed_precision and jdtype == jnp.float64) else 0.0,
+        )
         for k in range(scenarios)
     }
     pending = [k for k in range(scenarios) if skeys[k] not in done]
