@@ -70,6 +70,7 @@ def main():
     }
     with open(os.path.join(os.path.dirname(__file__), "..", "UC_SCALE.json"), "w") as f:
         json.dump(out, f, indent=1)
+        f.write("\n")
     return out
 
 
