@@ -341,6 +341,115 @@ def synthesize_fleet(
     )
 
 
+def synthesize_network(
+    n_buses: int = 30,
+    n_units: int = 50,
+    days: int = 2,
+    seed: int = 17,
+    peak_frac: float = 0.7,
+) -> GridData:
+    """RTS-like NETWORKED system for at-scale DC-OPF/co-sim validation:
+    the bundled fixture has 5 buses while the reference's source system is
+    the 73-bus RTS-GMLC (`prescient_options.py` runs Prescient on it).
+    Builds on `synthesize_fleet`'s unit classes, then:
+
+    * buses 1..n on a ring (guaranteed connected) plus ~n/3 random chords
+      (meshed corridors, so congestion can separate LMPs);
+    * units and loads spread across buses (round-robin by merit order for
+      units; load shares ~ Dirichlet weights per bus);
+    * per-bus load profiles = the system double-peak shape x the bus share
+      x small per-bus noise; one wind unit per ~10 buses;
+    * thermal line ratings sized to ~2.5x the uniform-injection flow scale
+      with a few deliberately tighter corridors (visible price spread
+      without infeasibility — the SCED's priced shed absorbs extremes).
+    """
+    rng = np.random.default_rng(seed)
+    base = synthesize_fleet(
+        n_units=n_units, days=days, seed=seed, peak_frac=peak_frac
+    )
+    H = days * 24
+    buses = list(range(1, n_buses + 1))
+    # ring + chords
+    bf = list(range(n_buses))
+    bt = [(i + 1) % n_buses for i in range(n_buses)]
+    n_chords = max(1, n_buses // 3)
+    for _ in range(n_chords):
+        a, b = rng.choice(n_buses, 2, replace=False)
+        bf.append(int(a))
+        bt.append(int(b))
+    nl = len(bf)
+    branch_b = 1.0 / rng.uniform(0.01, 0.08, nl)  # susceptance ~ 1/X
+
+    # place units round-robin in merit order so cheap capacity spreads out
+    order = np.argsort([u.avg_cost for u in base.thermal])
+    thermal = []
+    for slot, gi in enumerate(order):
+        u = base.thermal[gi]
+        thermal.append(dataclasses.replace(u, bus=buses[slot % n_buses]))
+    n_wind = max(1, n_buses // 10)
+    cap = sum(u.p_max for u in thermal)
+    wind_cap_each = 0.12 * cap / n_wind
+    renewable = [
+        RenewableUnit(f"W_{k + 1}", buses[(3 * k + 1) % n_buses], wind_cap_each)
+        for k in range(n_wind)
+    ]
+    wind_shape = base.da_renewables[:, 0] / max(
+        1e-9, float(base.da_renewables[:, 0].max())
+    )
+    ren = np.stack(
+        [
+            np.clip(
+                wind_cap_each
+                * wind_shape
+                * np.exp(rng.normal(0, 0.1, H)),
+                0.0,
+                wind_cap_each,
+            )
+            for _ in range(n_wind)
+        ],
+        axis=1,
+    )
+
+    # loads: every bus carries some share of the system profile
+    shares = rng.dirichlet(np.full(n_buses, 2.0))
+    sys_load = base.da_load[:, 0]
+    da_load = (
+        sys_load[:, None]
+        * shares[None, :]
+        * np.exp(rng.normal(0, 0.02, (H, n_buses)))
+    )
+    rt_load = da_load * np.exp(rng.normal(0, 0.01, (H, n_buses)))
+
+    # ratings: sized to the LARGEST single-bus injection (Dirichlet shares
+    # concentrate load, and a ring corridor may carry most of a bus's
+    # import), with a few deliberately tighter corridors for price spread
+    flow_scale = float(sys_load.max() * shares.max())
+    limits = flow_scale * rng.uniform(2.0, 4.0, nl)
+    # tighter corridors only among the CHORDS (a tight ring edge can
+    # strand a heavy bus whose ring segments are its only paths); there is
+    # always at least one chord (n_chords = max(1, n_buses // 3))
+    tight = n_buses + rng.choice(
+        nl - n_buses, max(1, (nl - n_buses) // 3), replace=False
+    )
+    limits[tight] = 1.1 * flow_scale
+    return GridData(
+        buses=buses,
+        branch_from=np.asarray(bf),
+        branch_to=np.asarray(bt),
+        branch_b=branch_b,
+        branch_limit=limits,
+        thermal=thermal,
+        renewable=renewable,
+        da_load=da_load,
+        rt_load=rt_load,
+        load_bus=buses,
+        da_renewables=ren,
+        rt_renewables=np.clip(ren * np.exp(rng.normal(0, 0.05, ren.shape)), 0.0, wind_cap_each),
+        reserve_mw=base.reserve_mw,
+        initial_on=base.initial_on,
+    )
+
+
 # ------------------------------------------------------------------ DC-OPF
 def dcopf_program(
     grid: GridData,
