@@ -347,6 +347,7 @@ def synthesize_network(
     days: int = 2,
     seed: int = 17,
     peak_frac: float = 0.7,
+    rating_mode: str = "injection",
 ) -> GridData:
     """RTS-like NETWORKED system for at-scale DC-OPF/co-sim validation:
     the bundled fixture has 5 buses while the reference's source system is
@@ -359,9 +360,17 @@ def synthesize_network(
       units; load shares ~ Dirichlet weights per bus);
     * per-bus load profiles = the system double-peak shape x the bus share
       x small per-bus noise; one wind unit per ~10 buses;
-    * thermal line ratings sized to ~2.5x the uniform-injection flow scale
-      with a few deliberately tighter corridors (visible price spread
-      without infeasibility — the SCED's priced shed absorbs extremes).
+    * thermal line ratings sized per `rating_mode`:
+      - ``"injection"`` (default): ~2-4x the largest single-bus injection
+        with a few tighter chords. Adequate to ~30 buses; beyond that,
+        ring-flow ACCUMULATION (aggregate transfers across ~n/4 hops)
+        exceeds any single-bus injection and the system sheds chronically.
+      - ``"flow"``: auto-size from physics — solve a full day of DC-OPFs
+        with effectively unlimited ratings under the operational
+        commitment (flows reroute hour to hour), set each line to 2x its
+        MAX observed loading (floored at half the injection scale), then
+        tighten the chosen chords to 1.3x. Scales to the 73-bus RTS-GMLC
+        count.
     """
     rng = np.random.default_rng(seed)
     base = synthesize_fleet(
@@ -420,19 +429,31 @@ def synthesize_network(
     )
     rt_load = da_load * np.exp(rng.normal(0, 0.01, (H, n_buses)))
 
-    # ratings: sized to the LARGEST single-bus injection (Dirichlet shares
-    # concentrate load, and a ring corridor may carry most of a bus's
-    # import), with a few deliberately tighter corridors for price spread
     flow_scale = float(sys_load.max() * shares.max())
-    limits = flow_scale * rng.uniform(2.0, 4.0, nl)
-    # tighter corridors only among the CHORDS (a tight ring edge can
-    # strand a heavy bus whose ring segments are its only paths); there is
-    # always at least one chord (n_chords = max(1, n_buses // 3))
+    # there is always at least one chord (n_chords = max(1, n_buses // 3));
+    # tighter corridors live only among the CHORDS (a tight ring edge can
+    # strand a heavy bus whose ring segments are its only paths).
+    # NOTE: the draw ORDER here (tight set before limits) is part of the
+    # seeded contract — the seed-17/23/5 test assertions pin the stream
     tight = n_buses + rng.choice(
         nl - n_buses, max(1, (nl - n_buses) // 3), replace=False
     )
-    limits[tight] = 1.1 * flow_scale
-    return GridData(
+    if rating_mode == "injection":
+        # largest single-bus injection x margin; adequate to ~30 buses
+        limits = flow_scale * rng.uniform(2.0, 4.0, nl)
+        limits[tight] = 1.1 * flow_scale
+    elif rating_mode == "flow":
+        # physics-based sizing pass: provisional ratings at 3x the total
+        # system load — no physical flow can reach that, so the sizing
+        # DC-OPF is effectively unconstrained, while staying inside the
+        # numerically well-scaled range (a 1e9 box wrecks the Ruiz
+        # equilibration and the sizing solves stop converging)
+        limits = np.full(nl, 3.0 * float(sys_load.max()))
+    else:
+        raise ValueError(
+            f"rating_mode must be 'injection' or 'flow', got {rating_mode!r}"
+        )
+    grid = GridData(
         buses=buses,
         branch_from=np.asarray(bf),
         branch_to=np.asarray(bt),
@@ -448,6 +469,37 @@ def synthesize_network(
         reserve_mw=base.reserve_mw,
         initial_on=base.initial_on,
     )
+    if rating_mode == "flow":
+        # flows reroute when commitment changes hour to hour, so size to
+        # the MAX loading over a full day of unconstrained solves under
+        # the operational (heuristic RUC) commitment, not one peak hour
+        prog = dcopf_program(grid)
+        T0 = min(24, H)
+        commit = UnitCommitment(grid).commit(
+            da_load[:T0].sum(1), ren[:T0].sum(1)
+        )
+        loads_bus = np.zeros((T0, n_buses))
+        for t in range(T0):
+            for c, v in zip(grid.load_bus, da_load[t]):
+                loads_bus[t, grid.bus_index(c)] = v
+        res = solve_hours(prog, grid, loads_bus, ren[:T0], commit)
+        if not np.asarray(res["converged"]).all():
+            raise RuntimeError(
+                "flow-based rating: the unconstrained sizing DC-OPF did "
+                "not converge for every hour — refusing to size lines "
+                "from unconverged iterates"
+            )
+        x_all = np.asarray(res["x"])  # (T0, n_var): one bulk transfer
+        flows = np.array(
+            [
+                float(np.abs(x_all[:, prog.col_index(f"flow{li}")]).max())
+                for li in range(nl)
+            ]
+        )
+        limits = np.maximum(2.0 * flows, 0.5 * flow_scale)
+        limits[tight] = np.maximum(1.3 * flows[tight], 0.3 * flow_scale)
+        grid = dataclasses.replace(grid, branch_limit=limits)
+    return grid
 
 
 # ------------------------------------------------------------------ DC-OPF
