@@ -6,7 +6,7 @@ subprocess solve per LMP scenario per sweep point
 (`load_parameters.py:104` reshapes the year to 52x168 h). Here the identical
 wind+battery+PEM weekly LP is lowered once and a vmapped interior-point solve
 runs the whole scenario x week batch on one chip. Two year-scale rows ride
-along: one monolithic 8,760-h design LP (mixed-precision block-tridiagonal
+along: one monolithic 8,760-h design LP (f32 8-slab SPIKE block-tridiagonal
 IPM, gated on objective error vs HiGHS), and a scenario-BATCH of year LPs
 (the BASELINE.md north-star axis).
 
@@ -17,28 +17,38 @@ vs solves/sec per CPU process.
 
 Resilience (round-4, after three rounds of rc=1 on tunnel outages): every
 device call runs under retry-with-backoff (7 attempts over ~7.5 min on
-tunnel/backend errors). On final failure a diagnostics file BENCH_DIAG.json
-is written and the printed JSON says where it died; on success a timestamped
-BENCH_LOCAL.json records the full result so a later capture-time outage
-cannot erase a measured number.
+tunnel/backend errors) plus a hang watchdog. On final failure a diagnostics
+file BENCH_DIAG.json is written and the printed JSON says where it died.
+BENCH_LOCAL.json is flushed INCREMENTALLY after every completed stage (not
+only at the end), so a late-stage outage or crash cannot erase an
+already-measured number.
+
+The year-batch row runs in a CHILD PROCESS (`--year-batch-child`): measured
+this round, a B=8 batch of 8,760-h banded LPs crashes the TPU worker
+("TPU worker process crashed or restarted" — the batch overruns worker
+memory), and after a worker crash the parent's in-process PJRT client is
+poisoned, so same-process retries fail forever. The child isolates the
+crash; the parent falls back B -> B/2 -> ... -> 1 with a fresh child each
+time and keeps its own device client healthy. A year-batch failure
+annotates the metric but does not fail the bench — the weekly row is the
+headline and its quality gates still apply.
 """
 import datetime
 import json
 import os
+import subprocess
 import sys
 import time
 import traceback
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Error signatures of the axon TPU tunnel / PJRT backend being transiently
-# unavailable (observed rounds 1-3: "Unable to initialize backend 'axon':
-# UNAVAILABLE", connection refused at the first device call).
+# unavailable (observed rounds 1-4: "Unable to initialize backend 'axon':
+# UNAVAILABLE", connection refused at the first device call, "TPU worker
+# process crashed or restarted" after an over-memory program).
 _RETRYABLE = (
     "unavailable",
     "unable to initialize backend",
@@ -54,10 +64,28 @@ _DELAYS = (15, 30, 45, 60, 90, 120, 120)  # 7 retries over 480 s
 
 
 _DIAG = {"attempts": [], "stage_times": {}}
+_LOCAL = {"partial": True, "rows": {}}
+_T_START = time.perf_counter()
+
+# year-solve recipe, shared by the single-year row (parent) and the
+# year-batch child: the child's convergence claim rests on using EXACTLY
+# the recipe the single-year row converged with on-chip (73-h blocks,
+# 8 SPIKE slabs; the 24-h-block f32 chain at Tb=365 measured 0/2 converged)
+YEAR_BLOCK_HOURS = 73
+YEAR_KW = dict(tol=1e-5, max_iter=80, refine_steps=3, slabs=8)
 
 
 def _now():
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def _atomic_dump(obj, path):
+    # write-temp + rename: a kill mid-flush must not truncate the previous
+    # record (the whole point of these files is surviving hard deaths)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
 
 
 def _write_diag(stage, fatal_error=None):
@@ -65,8 +93,19 @@ def _write_diag(stage, fatal_error=None):
     _DIAG["ts"] = _now()
     if fatal_error:
         _DIAG["fatal_error"] = fatal_error
-    with open(os.path.join(REPO, "BENCH_DIAG.json"), "w") as f:
-        json.dump(_DIAG, f, indent=1)
+    _atomic_dump(_DIAG, os.path.join(REPO, "BENCH_DIAG.json"))
+
+
+def _flush_local():
+    """Persist everything measured so far. Called after EVERY completed row:
+    a later worker crash, tunnel hang, or process kill must not erase a
+    measured number (round-3 verdict Weak #3; round-4 lesson — the first
+    live-chip run of the round measured weekly+year rows and then lost both
+    when the year-batch stage crashed the worker)."""
+    _LOCAL["ts"] = _now()
+    _LOCAL["elapsed_seconds"] = round(time.perf_counter() - _T_START, 1)
+    _LOCAL["stage_times"] = _DIAG["stage_times"]
+    _atomic_dump(_LOCAL, os.path.join(REPO, "BENCH_LOCAL.json"))
 
 
 def _fail(stage, n_attempts):
@@ -157,12 +196,163 @@ def _device(stage, fn, timeout_s=900.0):
     _fail(stage, len(_DELAYS) + 1)
 
 
+# ----------------------------------------------------------------------
+# Year-batch child: runs in its OWN process so a TPU-worker crash (the
+# observed failure for too-large batches) cannot poison the parent's
+# client. Reads inputs from an .npz, writes results next to it.
+# ----------------------------------------------------------------------
+
+def _year_batch_child(npz_path, By):
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    from dispatches_tpu.case_studies.renewables.pricetaker import (
+        HybridDesign,
+        build_pricetaker,
+    )
+    from dispatches_tpu.solvers.structured import (
+        extract_time_structure,
+        solve_lp_banded_batch,
+    )
+
+    dat = np.load(npz_path)
+    ylmp, ycf = dat["ylmp"], dat["ycf"]
+    scales = dat["scales"][:By]
+    Ty = int(ylmp.shape[0])
+    ydesign = HybridDesign(
+        T=Ty,
+        with_battery=True,
+        with_pem=True,
+        design_opt=True,
+        h2_price_per_kg=2.5,
+        initial_soc_fixed=None,
+    )
+    yprog, _ = build_pricetaker(ydesign)
+    meta = extract_time_structure(yprog, Ty, block_hours=YEAR_BLOCK_HOURS)
+    kw = YEAR_KW
+    cfd = jnp.asarray(ycf, jnp.float32)
+
+    def inst(s):
+        return jax.vmap(
+            lambda lm: meta.instantiate(
+                {"lmp": lm, "wind_cf": cfd}, dtype=jnp.float32
+            )
+        )(jnp.asarray(s[:, None] * ylmp[None, :], jnp.float32))
+
+    t_build = time.perf_counter()  # imports + model build excluded
+    sol = solve_lp_banded_batch(meta, inst(scales), **kw)
+    np.asarray(sol.obj)  # sync: compile+first run complete
+    warm_s = time.perf_counter() - t_build
+
+    # fresh jitter per run so the tunnel's (executable, inputs)
+    # memoization cannot serve a cache hit (round-2 lesson)
+    rng = np.random.default_rng(time.time_ns() % (2**32))
+    scales2 = scales * np.float32(1.0 + rng.uniform(-1e-5, 1e-5))
+    blp2 = inst(scales2)
+    t0 = time.perf_counter()
+    sol2 = solve_lp_banded_batch(meta, blp2, **kw)
+    objs = np.asarray(sol2.obj)
+    dt = time.perf_counter() - t0
+    out = {
+        "By": int(By),
+        "warm_seconds": round(warm_s, 2),
+        "seconds": round(dt, 3),
+        "objs": [float(v) for v in objs],
+        "converged": [bool(v) for v in np.asarray(sol2.converged)],
+        "scales_used": [float(v) for v in scales2],
+    }
+    # atomic: the parent treats this file's existence as proof of a
+    # delivered result, so a kill mid-write must not leave truncated JSON
+    _atomic_dump(out, npz_path + ".out.json")
+    print(json.dumps(out), flush=True)
+
+
+def _run_year_batch_via_child(ylmp, ycf, By0):
+    """Try the year-batch row at By0 in an isolated child process.
+
+    Failure policy (the child can die three ways):
+    - worker crash ("worker process crashed"): the program is too big for
+      the worker — HALVE By and retry (fresh child, fresh client);
+    - transient tunnel error/hang/timeout: retry the SAME By once before
+      halving (halving on a blip would misreport achievable throughput);
+    - anything else (genuine bug): record and halve (a smaller program
+      may still land a row; the stderr tail is preserved either way).
+    A total wall budget bounds the worst case (hang mode burns the full
+    per-child timeout each attempt). Returns the child's result dict or
+    {"failed": True, "fallback_errors": [...]}."""
+    rng = np.random.default_rng(time.time_ns() % (2**32))
+    scales = rng.uniform(0.7, 1.4, max(By0, 1)).astype(np.float32)
+    # pid-suffixed scratch: concurrent bench runs (a background watch loop
+    # plus the driver's capture run) must not clobber each other's inputs
+    # or pick up each other's results
+    npz_path = os.path.join(REPO, f".bench_yb_inputs.{os.getpid()}.npz")
+    out_path = npz_path + ".out.json"
+    np.savez(npz_path, ylmp=ylmp, ycf=ycf, scales=scales)
+    errors = []
+    By = By0
+    retried_this_By = False
+    t_total = time.perf_counter()
+    TOTAL_BUDGET_S = 2700.0
+    try:
+        while By >= 1:
+            t0 = time.perf_counter()
+            timed_out = False
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--year-batch-child", npz_path, str(By)],
+                    cwd=REPO,
+                    timeout=1500.0,
+                    capture_output=True,
+                    text=True,
+                )
+                rc, stderr = proc.returncode, proc.stderr or ""
+            except subprocess.TimeoutExpired as te:
+                timed_out = True
+                rc, stderr = -1, (te.stderr or "") if isinstance(
+                    te.stderr, str) else ""
+            # a child killed at/after completion may still have delivered:
+            # trust the result file, not the exit path
+            if os.path.exists(out_path):
+                with open(out_path) as f:
+                    out = json.load(f)
+                out["child_wall_seconds"] = round(
+                    time.perf_counter() - t0, 1)
+                out["fallback_errors"] = errors
+                return out
+            err_txt = ("child timeout 1500s" if timed_out
+                       else f"child rc={rc}") + (
+                f": {stderr[-2000:]}" if stderr else "")
+            errors.append({"By": By, "error": err_txt})
+            low = (stderr or "").lower()
+            crash = "worker process crashed" in low
+            transient = timed_out or (
+                not crash and any(p in low for p in _RETRYABLE))
+            if time.perf_counter() - t_total > TOTAL_BUDGET_S:
+                errors.append({"By": By, "error": "total budget exhausted"})
+                break
+            if transient and not retried_this_By:
+                retried_this_By = True  # same By, one more try
+                time.sleep(30)
+                continue
+            By //= 2
+            retried_this_By = False
+        return {"failed": True, "fallback_errors": errors}
+    finally:
+        for p in (npz_path, out_path):
+            if os.path.exists(p):
+                os.remove(p)
+
+
 def main():
-    t_start = time.perf_counter()
     # x64 on: every f32 tensor below is EXPLICIT; without this the
     # "f64 HiGHS reference" inputs (yp64, cpu_lps, yb_ref) would silently
     # truncate to f32 and the reported rel_err fields would measure input
     # quantization, not solver accuracy
+    import jax
+    import jax.numpy as jnp
+
     jax.config.update("jax_enable_x64", True)
     from dispatches_tpu.case_studies.renewables import params as P
     from dispatches_tpu.case_studies.renewables.pricetaker import (
@@ -184,6 +374,8 @@ def main():
     )
     assert abs(got - probe_val**0.5) < 1e-5
     _DIAG["devices"] = [str(d) for d in jax.devices()]
+    _LOCAL["devices"] = _DIAG["devices"]
+    _flush_local()
 
     T = 168  # one week per LP (reference weekly granularity)
     n_weeks = 52
@@ -279,6 +471,14 @@ def main():
     solves_per_sec = B / dt
     conv_frac = float(np.mean(conv))
     med_iters = float(np.median(iters))
+    _LOCAL["rows"]["weekly"] = {
+        "batch": B,
+        "seconds": round(dt, 3),
+        "solves_per_sec": round(solves_per_sec, 3),
+        "converged": conv_frac,
+        "median_iters": med_iters,
+    }
+    _flush_local()
 
     # Convergence gate: a throughput number for solves that did not converge
     # is not a benchmark (round-1 lesson: 679k "solves/sec" at converged=0).
@@ -323,6 +523,9 @@ def main():
     rel_err = float(
         np.max(np.abs(dev_objs - np.asarray(cpu_objs)) / (1.0 + np.abs(cpu_objs)))
     )
+    _LOCAL["rows"]["weekly"]["rel_err_vs_highs"] = rel_err
+    _LOCAL["rows"]["weekly"]["cpu_highs_solves_per_sec"] = cpu_solves_per_sec
+    _flush_local()
 
     # ------------------------------------------------------------------
     # Year rows: the 8,760-h design LP via the block-tridiagonal IPM
@@ -333,7 +536,6 @@ def main():
     from dispatches_tpu.solvers.structured import (
         extract_time_structure,
         solve_lp_banded,
-        solve_lp_banded_batch,
     )
 
     Ty = 8760
@@ -383,6 +585,11 @@ def main():
         )
 
     yobj, yconv, ydt, yjfac = _device("year timed solve", _year_timed)
+    _LOCAL["rows"]["year_single"] = {
+        "seconds": round(ydt, 3),
+        "converged": yconv,
+    }
+    _flush_local()
     # HiGHS year objective for the SAME (jittered) inputs: the accuracy
     # gate (~25 s on host, after the chip work is done)
     yref = solve_lp_scipy_sparse(
@@ -396,65 +603,59 @@ def main():
     # f32 year floor is ~1% (objective is a revenue-cost difference with
     # heavy cancellation); 5e-2 is the round-3 contract for pure f32
     yok = yconv and yerr < 5e-2
+    _LOCAL["rows"]["year_single"]["rel_err_vs_highs"] = yerr
+    _LOCAL["rows"]["year_single"]["gate_ok"] = yok
+    _flush_local()
 
-    # scenario-batched year row (north-star axis): B_y simultaneous 8,760-h
+    # scenario-batched year row (north-star axis): By simultaneous 8,760-h
     # design LPs, shared banded structure, per-scenario LMP draws, one vmap
-    By = int(os.environ.get("BENCH_YEAR_BATCH", "8"))
-    ybmeta = extract_time_structure(yprog, Ty, block_hours=24)
-    yscales = rng.uniform(0.7, 1.4, By).astype(np.float32)
+    # — in an ISOLATED CHILD PROCESS with By fallback (see module docstring)
+    By0 = int(os.environ.get("BENCH_YEAR_BATCH", "4"))
+    yb = _run_year_batch_via_child(ylmp, ycf, By0)
+    _LOCAL["rows"]["year_batch"] = yb
+    _flush_local()
 
-    def _batch_params(scales):
-        lmp_b = jnp.asarray(scales[:, None] * ylmp[None, :], jnp.float32)
-        return {
-            "lmp": lmp_b,
-            "wind_cf": jnp.asarray(ycf, jnp.float32),
-        }
-
-    def _instantiate_batch(scales):
-        pb = _batch_params(scales)
-        return jax.vmap(
-            lambda lm: ybmeta.instantiate(
-                {"lmp": lm, "wind_cf": pb["wind_cf"]}, dtype=jnp.float32
-            )
-        )(pb["lmp"])
-
-    ybkw = dict(tol=1e-5, max_iter=80, refine_steps=3)
-
-    def _ybatch_warm():
-        blp_b = _instantiate_batch(rng.uniform(0.7, 1.4, By).astype(np.float32))
-        sol = solve_lp_banded_batch(ybmeta, blp_b, **ybkw)
-        return np.asarray(sol.obj)
-
-    _device("year-batch warmup/compile", _ybatch_warm)
-
-    def _ybatch_timed():
-        # fresh jitter per attempt (see _timed); actual scales returned
-        # for the accuracy spot-check
-        scales = yscales * np.float32(1.0 + rng.uniform(-1e-5, 1e-5))
-        blp_b = _instantiate_batch(scales)
-        t0 = time.perf_counter()
-        sol = solve_lp_banded_batch(ybmeta, blp_b, **ybkw)
-        objs = np.asarray(sol.obj)
-        return objs, np.asarray(sol.converged), time.perf_counter() - t0, scales
-
-    ybobjs, ybconv, ybdt, yb_scales = _device(
-        "year-batch timed solve", _ybatch_timed
-    )
-    yb_conv_frac = float(np.mean(ybconv))
-    scen_years_per_min = By / ybdt * 60.0
-    t500 = 500.0 / (By / ybdt)  # projected single-chip 500-scenario time
-    # accuracy spot-check: scenario 0 vs HiGHS on the same scaled inputs
-    yb_ref = solve_lp_scipy_sparse(
-        yprog,
-        {"lmp": jnp.asarray(yb_scales[0] * ylmp, jnp.float64),
-         "wind_cf": jnp.asarray(ycf, jnp.float64)},
-    )
-    yb_err = abs(float(ybobjs[0]) - yb_ref.obj_with_offset) / max(
-        1.0, abs(yb_ref.obj_with_offset)
-    )
-    # north-star row gate: same contract as the other rows — throughput
-    # for unconverged or wrong solves is not a benchmark
-    yb_ok = yb_conv_frac >= 0.99 and yb_err < 5e-2
+    if not yb.get("failed"):
+        By = yb["By"]
+        ybdt = yb["seconds"]
+        yb_conv_frac = float(np.mean(yb["converged"]))
+        scen_years_per_min = By / ybdt * 60.0
+        t500 = 500.0 / (By / ybdt)  # projected single-chip 500-scenario time
+        # accuracy spot-check: scenario 0 vs HiGHS on the same scaled inputs
+        yb_ref = solve_lp_scipy_sparse(
+            yprog,
+            {"lmp": jnp.asarray(yb["scales_used"][0] * ylmp, jnp.float64),
+             "wind_cf": jnp.asarray(ycf, jnp.float64)},
+        )
+        yb_err = abs(yb["objs"][0] - yb_ref.obj_with_offset) / max(
+            1.0, abs(yb_ref.obj_with_offset)
+        )
+        # north-star row gate: same contract as the other rows — throughput
+        # for unconverged or wrong solves is not a benchmark
+        yb_ok = yb_conv_frac >= 0.99 and yb_err < 5e-2
+        _LOCAL["rows"]["year_batch"].update(
+            {
+                "scenario_years_per_min": round(scen_years_per_min, 3),
+                "converged_frac": yb_conv_frac,
+                "scen0_rel_err_vs_highs": yb_err,
+                "projected_500_scenarios_min": round(t500 / 60.0, 2),
+                "gate_ok": yb_ok,
+            }
+        )
+        _flush_local()
+        yb_txt = (
+            f"year x{By} scenario BATCH (child): {ybdt:.1f}s for {By} "
+            f"year-LPs = {scen_years_per_min:.1f} scenario-years/min/chip, "
+            f"converged={yb_conv_frac:.2f}, "
+            f"scen0_rel_err_vs_highs={yb_err:.1e}, "
+            f"projected 500 scenarios = {t500 / 60.0:.1f} min/chip"
+        )
+    else:
+        yb_ok = False
+        yb_txt = (
+            "year-batch row FAILED in child process (worker crash/timeout; "
+            "see BENCH_LOCAL.json fallback_errors)"
+        )
 
     result = {
         "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
@@ -462,61 +663,27 @@ def main():
         f"median_iters={med_iters:.0f}, max_rel_err_vs_highs={rel_err:.1e}; "
         f"year 8760h monolithic: {ydt:.1f}s f32 8-slab SPIKE, "
         f"converged={yconv}, rel_err_vs_highs={yerr:.1e}, gate_ok={yok}; "
-        f"year x{By} scenario BATCH: {ybdt:.1f}s for {By} year-LPs = "
-        f"{scen_years_per_min:.1f} scenario-years/min/chip, "
-        f"converged={yb_conv_frac:.2f}, scen0_rel_err_vs_highs={yb_err:.1e}, "
-        f"projected 500 scenarios = {t500 / 60.0:.1f} min/chip)",
+        f"{yb_txt})",
         "value": round(solves_per_sec, 3),
         "unit": "solves/sec",
         "vs_baseline": round(solves_per_sec / cpu_solves_per_sec, 2),
     }
     if not yok:
         result["metric"] = "YEAR GATE FAILED (see fields): " + result["metric"]
-    if not yb_ok:
+    if not yb_ok and not yb.get("failed"):
         result["metric"] = (
             "YEAR-BATCH GATE FAILED (see fields): " + result["metric"]
         )
 
-    # timestamped local success artifact: a capture-time outage must not
-    # erase a measured number (round-3 verdict, Weak #3)
-    with open(os.path.join(REPO, "BENCH_LOCAL.json"), "w") as f:
-        json.dump(
-            {
-                "ts": _now(),
-                "result": result,
-                "detail": {
-                    "weekly": {
-                        "batch": B,
-                        "solves_per_sec": solves_per_sec,
-                        "converged": conv_frac,
-                        "median_iters": med_iters,
-                        "rel_err_vs_highs": rel_err,
-                        "cpu_highs_solves_per_sec": cpu_solves_per_sec,
-                    },
-                    "year_single": {
-                        "seconds": ydt,
-                        "converged": yconv,
-                        "rel_err_vs_highs": yerr,
-                    },
-                    "year_batch": {
-                        "B": By,
-                        "seconds": ybdt,
-                        "scenario_years_per_min": scen_years_per_min,
-                        "converged_frac": yb_conv_frac,
-                        "scen0_rel_err_vs_highs": yb_err,
-                        "projected_500_scenarios_min": t500 / 60.0,
-                        "gate_ok": yb_ok,
-                    },
-                    "stage_times": _DIAG["stage_times"],
-                    "total_seconds": time.perf_counter() - t_start,
-                },
-            },
-            f,
-            indent=1,
-        )
+    _LOCAL["partial"] = False
+    _LOCAL["result"] = result
+    _flush_local()
 
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--year-batch-child":
+        _year_batch_child(sys.argv[2], int(sys.argv[3]))
+    else:
+        main()
