@@ -46,9 +46,15 @@ import numpy as np
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Error signatures of the axon TPU tunnel / PJRT backend being transiently
-# unavailable (observed rounds 1-4: "Unable to initialize backend 'axon':
-# UNAVAILABLE", connection refused at the first device call, "TPU worker
-# process crashed or restarted" after an over-memory program).
+# unavailable (observed rounds 1-3: "Unable to initialize backend 'axon':
+# UNAVAILABLE", connection refused at the first device call). The round-4
+# "TPU worker process crashed or restarted" message ALSO contains
+# "UNAVAILABLE", but same-process retries after a worker crash fail
+# forever (the PJRT client is poisoned — measured live: 8/8 instant
+# failures), so `_device` checks `_FATAL_FAST` first and gives up
+# immediately; only a fresh process (the year-batch child, or the next
+# watch-loop bench run) can recover.
+_FATAL_FAST = ("worker process crashed",)
 _RETRYABLE = (
     "unavailable",
     "unable to initialize backend",
@@ -81,8 +87,10 @@ def _now():
 
 def _atomic_dump(obj, path):
     # write-temp + rename: a kill mid-flush must not truncate the previous
-    # record (the whole point of these files is surviving hard deaths)
-    tmp = path + ".tmp"
+    # record (the whole point of these files is surviving hard deaths).
+    # pid-unique tmp: concurrent runs (watch loop + driver capture) must
+    # not race on one tmp path.
+    tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=1)
     os.replace(tmp, path)
@@ -190,6 +198,9 @@ def _device(stage, fn, timeout_s=900.0):
             )
             if isinstance(e, _StageTimeout):
                 continue  # retryable by definition
+            if any(pat in msg.lower() for pat in _FATAL_FAST):
+                _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
+                _fail(stage, i + 1)
             if not any(pat in msg.lower() for pat in _RETRYABLE):
                 _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
                 raise
@@ -288,6 +299,10 @@ def _run_year_batch_via_child(ylmp, ycf, By0):
     # or pick up each other's results
     npz_path = os.path.join(REPO, f".bench_yb_inputs.{os.getpid()}.npz")
     out_path = npz_path + ".out.json"
+    if os.path.exists(out_path):
+        # a hard-killed prior run with a recycled pid could have left a
+        # stale result; it must not be returned as this run's measurement
+        os.remove(out_path)
     np.savez(npz_path, ylmp=ylmp, ycf=ycf, scales=scales)
     errors = []
     By = By0
@@ -554,8 +569,8 @@ def main():
     # single-year row: 8-slab SPIKE decomposition, f32 data + f32 factor
     # with full-precision-in-dtype refinement; gated on objective error
     # against HiGHS, not just `converged`
-    ymeta = extract_time_structure(yprog, Ty, block_hours=73)
-    ykw = dict(tol=1e-5, max_iter=80, refine_steps=3, slabs=8)
+    ymeta = extract_time_structure(yprog, Ty, block_hours=YEAR_BLOCK_HOURS)
+    ykw = YEAR_KW
     yparams = {
         "lmp": jnp.asarray(ylmp, jnp.float32),
         "wind_cf": jnp.asarray(ycf, jnp.float32),

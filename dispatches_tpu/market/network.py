@@ -1283,7 +1283,20 @@ class ProductionCostSimulator:
             out[g.bus_index(c)] = v
         return out
 
-    def simulate(self, n_days: int, coordinator=None, tracking_horizon: int = 4):
+    def simulate(
+        self,
+        n_days: int,
+        coordinator=None,
+        tracking_horizon: int = 4,
+        progress=None,
+    ):
+        """Run the RUC + hourly-SCED cadence for `n_days`.
+
+        `progress(day, results)`, when given, is called after each simulated
+        day with the day index and the results-so-far — the analogue of
+        Prescient writing its output CSVs as the simulation advances, so a
+        year-long run can checkpoint instead of holding 8,760 rows hostage
+        to the final return."""
         g = self.grid
         for day in range(n_days):
             h0 = day * 24
@@ -1347,6 +1360,8 @@ class ProductionCostSimulator:
                 for bi, b in enumerate(g.buses):
                     row[f"LMP bus{b}"] = float(sced["lmp"][0, bi])
                 self.results.append(row)
+            if progress is not None:
+                progress(day, self.results)
         return self.results
 
     # -- participant bid plumbing ---------------------------------------
