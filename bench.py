@@ -96,6 +96,23 @@ def _atomic_dump(obj, path):
     os.replace(tmp, path)
 
 
+def _sweep_stale_tmps(min_age_s=7200.0):
+    # pid-unique tmps stranded by hard kills would otherwise accumulate
+    # forever (the old fixed names were overwritten by the next run).
+    # Age-gated: a CONCURRENT run's live scratch files must not be swept.
+    import glob
+
+    now = time.time()
+    for p in glob.glob(os.path.join(REPO, "*.json.*.tmp")) + glob.glob(
+        os.path.join(REPO, ".bench_yb_inputs.*.npz*")
+    ):
+        try:
+            if now - os.path.getmtime(p) > min_age_s:
+                os.remove(p)
+        except OSError:
+            pass
+
+
 def _write_diag(stage, fatal_error=None):
     _DIAG["failed_stage"] = stage
     _DIAG["ts"] = _now()
@@ -361,6 +378,7 @@ def _run_year_batch_via_child(ylmp, ycf, By0):
 
 
 def main():
+    _sweep_stale_tmps()
     # x64 on: every f32 tensor below is EXPLICIT; without this the
     # "f64 HiGHS reference" inputs (yp64, cpu_lps, yb_ref) would silently
     # truncate to f32 and the reported rel_err fields would measure input

@@ -379,53 +379,99 @@ def extract_time_structure(
 # ----------------------------------------------------------------------
 # Block-tridiagonal Cholesky (scan) + Woodbury border
 # ----------------------------------------------------------------------
-def _block_chol(Ds, Es):
+def _block_chol(Ds, Es, inv=False):
     """Factor the block-tridiagonal SPD matrix with diagonal blocks `Ds`
     and sub-diagonal blocks `Es` (Es[0] ignored) as L_blk L_blk^T where
     L_blk has lower-triangular L_t on the diagonal and C_t on the
-    sub-diagonal: D_t = C_t C_t^T + L_t L_t^T, E_t = C_t L_{t-1}^T."""
+    sub-diagonal: D_t = C_t C_t^T + L_t L_t^T, E_t = C_t L_{t-1}^T.
 
-    def step(Lprev, DE):
-        D, E = DE
-        # C = E Lprev^{-T}
-        C = lax.linalg.triangular_solve(
-            Lprev, E, left_side=False, lower=True, transpose_a=True
-        )
-        Lt = jnp.linalg.cholesky(D - C @ C.T)
-        return Lt, (Lt, C)
+    With ``inv=True`` the first return holds the INVERSES L_t^{-1}
+    (computed by one rank-mB triangular solve per block — an MXU-friendly
+    shape) instead of L_t. The factor chain's own trisolve disappears
+    (C = E Lprev^{-T} becomes a matmul) and, more importantly, every
+    `_bt_solve` sweep step applies the factor by MATMUL: the IPM issues
+    ~8 rank-1 solves per iteration, and on TPU a chain of small rank-1
+    triangular solves is latency-bound where matvecs pipeline."""
+    if inv:
+        eye = jnp.eye(Ds.shape[1], dtype=Ds.dtype)
 
-    L0 = jnp.linalg.cholesky(Ds[0])
-    _, (Ls, Cs) = lax.scan(step, L0, (Ds[1:], Es[1:]))
-    Ls = jnp.concatenate([L0[None], Ls])
+        def tinv(L):
+            return lax.linalg.triangular_solve(
+                L, eye, left_side=True, lower=True
+            )
+
+        def step(Jprev, DE):
+            D, E = DE
+            C = E @ Jprev.T  # = E Lprev^{-T}
+            J = tinv(jnp.linalg.cholesky(D - C @ C.T))
+            return J, (J, C)
+
+        J0 = tinv(jnp.linalg.cholesky(Ds[0]))
+        _, (Ls, Cs) = lax.scan(step, J0, (Ds[1:], Es[1:]))
+        Ls = jnp.concatenate([J0[None], Ls])
+    else:
+
+        def step(Lprev, DE):
+            D, E = DE
+            # C = E Lprev^{-T}
+            C = lax.linalg.triangular_solve(
+                Lprev, E, left_side=False, lower=True, transpose_a=True
+            )
+            Lt = jnp.linalg.cholesky(D - C @ C.T)
+            return Lt, (Lt, C)
+
+        L0 = jnp.linalg.cholesky(Ds[0])
+        _, (Ls, Cs) = lax.scan(step, L0, (Ds[1:], Es[1:]))
+        Ls = jnp.concatenate([L0[None], Ls])
     Cs = jnp.concatenate([jnp.zeros_like(Es[:1]), Cs])
     return Ls, Cs
 
 
-def _bt_solve(Ls, Cs, r):
+def _bt_solve(Ls, Cs, r, inv=False):
     """Solve the factored block-tridiagonal system for RHS r of shape
-    (Tb, mB) or (Tb, mB, k)."""
+    (Tb, mB) or (Tb, mB, k). `inv` says `Ls` holds L_t^{-1} (see
+    `_block_chol`): sweep steps are then matmuls, not triangular solves."""
     vec = r.ndim == 2
     if vec:
         r = r[..., None]
     mB, k = r.shape[1], r.shape[2]
 
-    def fwd(vprev, LCr):
-        L, C, rt = LCr
-        v = lax.linalg.triangular_solve(
-            L, rt - C @ vprev, left_side=True, lower=True
-        )
-        return v, v
+    if inv:
+
+        def fwd(vprev, LCr):
+            J, C, rt = LCr
+            v = J @ (rt - C @ vprev)
+            return v, v
+
+    else:
+
+        def fwd(vprev, LCr):
+            L, C, rt = LCr
+            v = lax.linalg.triangular_solve(
+                L, rt - C @ vprev, left_side=True, lower=True
+            )
+            return v, v
 
     _, vs = lax.scan(fwd, jnp.zeros((mB, k), r.dtype), (Ls, Cs, r))
 
     Cnext = jnp.concatenate([Cs[1:], jnp.zeros_like(Cs[:1])])
 
-    def bwd(xnext, LCv):
-        L, Cn, v = LCv
-        x = lax.linalg.triangular_solve(
-            L, v - Cn.T @ xnext, left_side=True, lower=True, transpose_a=True
-        )
-        return x, x
+    if inv:
+
+        def bwd(xnext, LCv):
+            J, Cn, v = LCv
+            x = J.T @ (v - Cn.T @ xnext)
+            return x, x
+
+    else:
+
+        def bwd(xnext, LCv):
+            L, Cn, v = LCv
+            x = lax.linalg.triangular_solve(
+                L, v - Cn.T @ xnext, left_side=True, lower=True,
+                transpose_a=True,
+            )
+            return x, x
 
     _, xs = lax.scan(
         bwd, jnp.zeros((mB, k), r.dtype), (Ls, Cnext, vs), reverse=True
@@ -498,18 +544,20 @@ def _slab_shard(mesh, axis):
     return lambda a: jax.lax.with_sharding_constraint(a, sh)
 
 
-def _slab_chol(Ds, Es, D, mesh=None, axis="time") -> _SlabFactors:
+def _slab_chol(Ds, Es, D, mesh=None, axis="time", inv=False) -> _SlabFactors:
     """Factor the block-tridiagonal SPD system by substructuring: interior
     chains (vmapped `_block_chol` over slabs) + interface Schur complement.
-    With `mesh`, the slab axis is sharded one-slab-per-device."""
+    With `mesh`, the slab axis is sharded one-slab-per-device. `inv`
+    stores inverse diagonal factors (see `_block_chol`) in both the
+    interior chains and the interface Schur chain."""
     S, D_int, D_ifc, E_chain, E_prev, E_self = _slab_split(Ds, Es, D)
     mB = Ds.shape[1]
     shard = _slab_shard(mesh, axis)
     D_int, E_chain = shard(D_int), shard(E_chain)
 
-    Ls_int, Cs_int = jax.vmap(_block_chol)(D_int, E_chain)
+    Ls_int, Cs_int = jax.vmap(partial(_block_chol, inv=inv))(D_int, E_chain)
     Ls_int, Cs_int = shard(Ls_int), shard(Cs_int)
-    solve_int = jax.vmap(_bt_solve)  # over slabs
+    solve_int = jax.vmap(partial(_bt_solve, inv=inv))  # over slabs
 
     # spikes: K_int^-1 applied to the (block-sparse) coupling columns —
     # one solve with both column groups stacked (the interior scan is the
@@ -532,12 +580,13 @@ def _slab_chol(Ds, Es, D, mesh=None, axis="time") -> _SlabFactors:
     # Schur sub-diagonal (rows I_d, cols I_{d-1}): -E_self[d] X[d, S-2]
     S_sub = -jnp.einsum("dij,djk->dik", E_self, X[:, S - 2])
     S_sub = S_sub.at[0].set(jnp.zeros_like(S_sub[0]))
-    Ls_schur, Cs_schur = _block_chol(S_diag, S_sub)
+    Ls_schur, Cs_schur = _block_chol(S_diag, S_sub, inv=inv)
     return _SlabFactors(Ls_int, Cs_int, X, Y, Ls_schur, Cs_schur, E_prev, E_self)
 
 
-def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time"):
-    """Solve using `_slab_chol` factors; r is (Tb, mB) or (Tb, mB, k)."""
+def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time", inv=False):
+    """Solve using `_slab_chol` factors; r is (Tb, mB) or (Tb, mB, k).
+    `inv` must match the `_slab_chol` call that built `f`."""
     vec = r.ndim == 2
     if vec:
         r = r[..., None]
@@ -548,11 +597,11 @@ def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time"):
     rr = r.reshape(D, S, mB, k)
     r_int, r_ifc = shard(rr[:, : S - 1]), rr[:, S - 1]
 
-    h = shard(jax.vmap(_bt_solve)(f.Ls_int, f.Cs_int, r_int))  # K_int^-1 r
+    h = shard(jax.vmap(partial(_bt_solve, inv=inv))(f.Ls_int, f.Cs_int, r_int))
     # interface RHS: g_d = r_I[d] - E_self[d] h[d, S-2] - E_prev[d+1]^T h[d+1, 0]
     g = r_ifc - jnp.einsum("dij,djk->dik", f.E_self, h[:, S - 2])
     g = g - _shift_up(jnp.einsum("dji,djk->dik", f.E_prev, h[:, 0]))
-    x_ifc = _bt_solve(f.Ls_schur, f.Cs_schur, g)  # (D, mB, k)
+    x_ifc = _bt_solve(f.Ls_schur, f.Cs_schur, g, inv=inv)  # (D, mB, k)
 
     # back-substitute: x_int = h - X x_I[d-1] - Y x_I[d]
     x_prev = _shift_down(x_ifc)
@@ -567,7 +616,7 @@ def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time"):
 
 def _banded_ops(
     Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None, slabs=None, mesh=None,
-    chol_dtype=None, kkt_refine=0, fac_d_cap=None,
+    chol_dtype=None, kkt_refine=0, fac_d_cap=None, inv_factors=False,
 ):
     """(matvec, rmatvec, make_kkt_solver) for `ipm._solve_scaled`, operating
     on flat vectors laid out [Tb*nB time-cols | p border-cols] (x-space) and
@@ -651,16 +700,20 @@ def _banded_ops(
         Ds = Ds + jax.vmap(jnp.diag)(diag_vec.astype(cd))
         Es = jnp.einsum("tij,tj,tkj->tik", As_c, wprev_c, _shift_down(Ad_c))
         if slabs:
-            fac = _slab_chol(Ds, Es, slabs, mesh=mesh)
+            fac = _slab_chol(Ds, Es, slabs, mesh=mesh, inv=inv_factors)
 
             def chol_base(rt):
-                return _slab_solve(fac, rt.astype(cd), mesh=mesh).astype(dtype)
+                return _slab_solve(
+                    fac, rt.astype(cd), mesh=mesh, inv=inv_factors
+                ).astype(dtype)
 
         else:
-            Ls, Cs = _block_chol(Ds, Es)
+            Ls, Cs = _block_chol(Ds, Es, inv=inv_factors)
 
             def chol_base(rt):
-                return _bt_solve(Ls, Cs, rt.astype(cd)).astype(dtype)
+                return _bt_solve(
+                    Ls, Cs, rt.astype(cd), inv=inv_factors
+                ).astype(dtype)
 
         if kkt_refine and cd != dtype:
             # K y = A_t W_t A_t^T y + diag_shift y, all in the full dtype;
@@ -763,12 +816,13 @@ def _ruiz_banded(Ad, As, Bb, iters: int = 8):
     jax.jit,
     static_argnames=(
         "meta", "max_iter", "refine_steps", "d_cap", "slabs", "mesh",
-        "chol_dtype", "kkt_refine",
+        "chol_dtype", "kkt_refine", "inv_factors",
     ),
 )
 def _solve_banded_jit(
     meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs=None,
     mesh=None, chol_dtype=None, kkt_refine=0, fac_d_cap=None,
+    inv_factors=False,
 ):
     Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0 = blp
     dtype = Ad.dtype
@@ -800,7 +854,7 @@ def _solve_banded_jit(
             Ad_s, As_s, Bb_s, Tb, mB, nB, p, reg_d,
             pad_rows=meta.pad_rows, slabs=slabs, mesh=mesh,
             chol_dtype=chol_dtype, kkt_refine=kkt_refine,
-            fac_d_cap=fac_d_cap,
+            fac_d_cap=fac_d_cap, inv_factors=inv_factors,
         )
         sol = _solve_scaled(
             LPData(
@@ -860,6 +914,7 @@ def solve_lp_banded(
     mesh_axis: str = "time",
     chol_dtype=None,
     kkt_refine: int = 0,
+    inv_factors: bool = False,
 ) -> IPMSolution:
     """Solve a time-banded LP by the block-tridiagonal IPM. Returns a
     solution with ``x`` in the CompiledLP's reduced column order, so
@@ -890,7 +945,18 @@ def solve_lp_banded(
     refinement step that worsens the residual is rejected. Validated at
     year scale: rel 5.9e-4 of f64-HiGHS on the 8,760-h design LP, asserted
     at the 1e-3 contract (see
-    `tests/test_structured.py::test_year_mixed_precision_refined`)."""
+    `tests/test_structured.py::test_year_mixed_precision_refined`).
+
+    ``inv_factors=True`` stores the block Cholesky factors as their
+    INVERSES (one extra rank-mB triangular solve per block at factor
+    time — an MXU-friendly shape) so every sweep step of every KKT solve
+    applies factors by matmul instead of a rank-1 triangular solve. The
+    IPM issues ~8 rank-1 KKT solves per iteration; on TPU those sweeps
+    otherwise serialize into hundreds of latency-bound small trisolves,
+    while matvecs pipeline on the MXU. Same flop class, slightly
+    different rounding (inverse-apply is not backward stable; the IPM's
+    refine_steps/kkt_refine correct residuals) — accuracy vs the
+    substitution path is asserted in tests."""
     dtype = blp.Ad.dtype
     if chol_dtype is not None:
         chol_dtype = jnp.dtype(chol_dtype)
@@ -945,7 +1011,7 @@ def solve_lp_banded(
             mesh = Mesh(mesh.devices, ("time",))
     return _solve_banded_jit(
         meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs,
-        mesh, chol_dtype, kkt_refine, fac_d_cap,
+        mesh, chol_dtype, kkt_refine, fac_d_cap, inv_factors,
     )
 
 

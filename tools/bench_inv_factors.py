@@ -1,0 +1,119 @@
+"""On-chip A/B: year-solve with substitution vs inverse block factors.
+
+The 8,760-h banded IPM measured 12.7 s on the chip (BENCH_NOTES.md) —
+~2% of the chip's matmul peak for the flop count — and the prime suspect
+is the solve phase: ~8 rank-1 KKT solves per IPM iteration, each a
+sequential chain of small triangular solves, which TPUs execute at
+latency, not throughput. `inv_factors=True` (solvers/structured.py)
+stores L_t^{-1} instead of L_t so every sweep step is a matmul.
+
+Run on the real TPU (no driver involvement):
+    python tools/bench_inv_factors.py
+Prints one timing line per mode + accuracy vs host HiGHS, and appends a
+JSON record to INV_FACTORS_AB.json.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+from dispatches_tpu.case_studies.renewables import params as P  # noqa: E402
+from dispatches_tpu.case_studies.renewables.pricetaker import (  # noqa: E402
+    HybridDesign,
+    build_pricetaker,
+)
+from dispatches_tpu.solvers.reference import solve_lp_scipy_sparse  # noqa: E402
+from dispatches_tpu.solvers.structured import (  # noqa: E402
+    extract_time_structure,
+    solve_lp_banded,
+)
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "INV_FACTORS_AB.json")
+
+
+def main():
+    Ty = 8760
+    design = HybridDesign(
+        T=Ty,
+        with_battery=True,
+        with_pem=True,
+        design_opt=True,
+        h2_price_per_kg=2.5,
+        initial_soc_fixed=None,
+    )
+    prog, _ = build_pricetaker(design)
+    data = P.load_rts303()
+    rng = np.random.default_rng(time.time_ns() % (2**32))
+    ylmp = np.tile(data["da_lmp"], 2)[:Ty] * rng.uniform(0.97, 1.03, Ty)
+    ycf = np.tile(data["da_wind_cf"], 2)[:Ty]
+    meta = extract_time_structure(prog, Ty, block_hours=73)
+    kw = dict(tol=1e-5, max_iter=80, refine_steps=3, slabs=8)
+
+    print(f"devices: {jax.devices()}", flush=True)
+    ref = solve_lp_scipy_sparse(
+        prog,
+        {"lmp": jnp.asarray(ylmp), "wind_cf": jnp.asarray(ycf)},
+    ).obj_with_offset
+    rows = {}
+    for inv in (False, True):
+        label = "inv" if inv else "sub"
+        blp = meta.instantiate(
+            {"lmp": jnp.asarray(ylmp, jnp.float32),
+             "wind_cf": jnp.asarray(ycf, jnp.float32)},
+            dtype=jnp.float32,
+        )
+        t0 = time.perf_counter()
+        sol = solve_lp_banded(meta, blp, inv_factors=inv, **kw)
+        np.asarray(sol.obj)
+        warm = time.perf_counter() - t0
+        # timed run on jittered inputs (tunnel memoization guard)
+        jf = np.float32(1.0 + rng.uniform(0.5e-6, 5e-6))
+        blp2 = meta.instantiate(
+            {"lmp": jnp.asarray(ylmp * jf, jnp.float32),
+             "wind_cf": jnp.asarray(ycf, jnp.float32)},
+            dtype=jnp.float32,
+        )
+        t0 = time.perf_counter()
+        sol2 = solve_lp_banded(meta, blp2, inv_factors=inv, **kw)
+        obj = float(np.asarray(sol2.obj))
+        dt = time.perf_counter() - t0
+        err = abs(obj - ref) / (1 + abs(ref))
+        rows[label] = {
+            "seconds": round(dt, 3),
+            "warm_seconds": round(warm, 1),
+            "converged": bool(np.asarray(sol2.converged)),
+            "iterations": int(np.asarray(sol2.iterations)),
+            "rel_err_vs_highs": err,
+        }
+        print(
+            f"{label}: {dt:.2f}s (warm {warm:.0f}s) conv={rows[label]['converged']}"
+            f" iters={rows[label]['iterations']} rel_err={err:.1e}",
+            flush=True,
+        )
+    rows["speedup_inv_over_sub"] = round(
+        rows["sub"]["seconds"] / rows["inv"]["seconds"], 2
+    )
+    rows["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    hist = []
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            hist = json.load(f)
+    hist.append(rows)
+    tmp = OUT + f".{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(hist, f, indent=1)
+    os.replace(tmp, OUT)
+    print(json.dumps(rows))
+
+
+if __name__ == "__main__":
+    main()

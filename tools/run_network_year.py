@@ -16,7 +16,8 @@ Writes NETWORK_YEAR.json at the repo root after every simulated day
    "sced_unconverged", "shed_hours", "total_cost", "lmp_stats",
    "congested_hour_frac", "wall_seconds", ...}
 
-Run:  python tools/run_network_year.py [days] [n_buses]
+Run:  python tools/run_network_year.py [days] [n_buses] [n_units]
+(n_units defaults to n_buses — the RTS-GMLC proportion.)
 """
 import json
 import os
@@ -42,10 +43,15 @@ from dispatches_tpu.market.network import (  # noqa: E402
 OUT = os.path.join(os.path.dirname(__file__), "..", "NETWORK_YEAR.json")
 
 
-def main(days: int = 365, n_buses: int = 73) -> dict:
+def main(days: int = 365, n_buses: int = 73, n_units: int = None) -> dict:
     t0 = time.time()
+    # default fleet size tracks the bus count (the RTS-GMLC proportion:
+    # 73 thermal units on 73 buses) so scaled-down smoke runs stay a
+    # proportioned system, not 73 units crammed onto 10 buses
+    n_units = n_units if n_units is not None else n_buses
     grid = synthesize_network(
-        n_buses=n_buses, n_units=73, days=days, seed=31, rating_mode="flow"
+        n_buses=n_buses, n_units=n_units, days=days, seed=31,
+        rating_mode="flow",
     )
     sim = ProductionCostSimulator(grid)
 
@@ -80,7 +86,7 @@ def main(days: int = 365, n_buses: int = 73) -> dict:
             "wall_seconds": round(time.time() - t0, 1),
             "sceds_per_second": round(len(rows) / (time.time() - t0), 3),
         }
-        tmp = OUT + ".tmp"
+        tmp = f"{OUT}.{os.getpid()}.tmp"  # pid-unique: no cross-run races
         with open(tmp, "w") as f:
             json.dump(out, f, indent=1)
         os.replace(tmp, OUT)
@@ -103,5 +109,6 @@ def main(days: int = 365, n_buses: int = 73) -> dict:
 if __name__ == "__main__":
     d = int(sys.argv[1]) if len(sys.argv) > 1 else 365
     nb = int(sys.argv[2]) if len(sys.argv) > 2 else 73
-    out = main(d, nb)
+    nu = int(sys.argv[3]) if len(sys.argv) > 3 else None
+    out = main(d, nb, nu)
     print(json.dumps(out))
