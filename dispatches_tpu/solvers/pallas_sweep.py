@@ -1,0 +1,151 @@
+"""Pallas TPU kernel: fused block-bidiagonal sweep chains.
+
+The banded IPM's KKT solves are two sweeps over time blocks
+(`structured._bt_solve`): with inverse factors (`inv_factors=True`) each
+step is two matvecs, but XLA still runs the chain as a `lax.scan` of
+separate ops with per-step overhead and HBM round-trips for the carry.
+This kernel fuses a WHOLE chain into one program: the carry vector lives
+in VMEM scratch across grid steps, and each step streams its two factor
+blocks from HBM and issues two MXU matmuls — the sweep runs at HBM
+bandwidth (the factors are the traffic; the carry never leaves the chip).
+
+Layout: row-vector form. The recurrence
+
+    v_t = J_t (r_t - C_t v_{t-1})        (forward sweep)
+
+is computed transposed, ``vT_t = (rT_t - vT_{t-1} @ CT_t) @ JT_t``, so the
+right-hand side tile is (8, m) — sublane-aligned for small k instead of
+padding k up to a 128 lane. One kernel serves both sweeps:
+
+    OUT_t = (IN_t - CARRY @ B_t) @ A_t,   CARRY := OUT_t
+
+- forward:  A_t = J_t^T,      B_t = C_t^T,      ascending t
+- backward: A_t = J_t,        B_t = C_{t+1},    descending t
+  (x_t = J_t^T (v_t - C_{t+1}^T x_{t+1}) transposes to
+   xT_t = (vT_t - xT_{t+1} @ C_{t+1}) @ J_t; descending order is the
+   ascending kernel over time-flipped streams)
+
+The grid is (n_chains, steps): the slab (SPIKE) decomposition's D interior
+chains map to the first grid axis — TPU grids iterate the LAST axis
+innermost, so each chain runs sequentially while the carry resets at step
+0 of every chain. The non-slab path is n_chains=1.
+
+Reference anchor: this replaces the per-scenario CBC/IPOPT subprocess
+solves of `dispatches/case_studies/renewables_case/wind_battery_LMP.py:
+195-267` at year scale; the chain structure is the time-coupling of
+`wind_battery_LMP.py:22-37` (battery SoC linking) turned into KKT algebra.
+
+Used only on TPU behind `solve_lp_banded(..., sweep_backend="pallas")`;
+`interpret=True` (forced on CPU) runs the same kernel through the Pallas
+interpreter for tests.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# pallas ships with jax; import directly so a broken/ancient jax build
+# fails HERE with the real ImportError, not with a NameError mid-trace
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+SUB = 8  # f32 sublane
+
+
+def _pad_to(x, target, axis):
+    n = x.shape[axis]
+    if n == target:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - n)
+    return jnp.pad(x, pad)
+
+
+def _chain_kernel(in_ref, b_ref, a_ref, out_ref, carry):
+    """One grid step: OUT = (IN - CARRY @ B) @ A; CARRY := OUT."""
+    s = pl.program_id(1)
+
+    @pl.when(s == 0)
+    def _():
+        carry[...] = jnp.zeros_like(carry)
+
+    t = in_ref[0, 0] - jnp.dot(
+        carry[...], b_ref[0, 0], preferred_element_type=jnp.float32
+    )
+    v = jnp.dot(t, a_ref[0, 0], preferred_element_type=jnp.float32)
+    carry[...] = v
+    out_ref[0, 0] = v
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def chain_sweep(RT, BT, AT, interpret=False):
+    """Run the fused recurrence over (n_chains, steps) chains.
+
+    RT: (D, S, kp, mp) right-hand sides (row form, kp = padded k <= 8 ok)
+    BT: (D, S, mp, mp) carry-coupling blocks
+    AT: (D, S, mp, mp) output blocks
+    Returns (D, S, kp, mp). All dims must already be tile-aligned
+    (kp multiple of 8, mp multiple of 128); use `sweep` for the
+    pad/transpose/flip plumbing.
+    """
+    D, S, kp, mp = RT.shape
+    grid = (D, S)
+    spec_r = pl.BlockSpec((1, 1, kp, mp), lambda d, s: (d, s, 0, 0))
+    spec_m = pl.BlockSpec((1, 1, mp, mp), lambda d, s: (d, s, 0, 0))
+    return pl.pallas_call(
+        _chain_kernel,
+        grid=grid,
+        in_specs=[spec_r, spec_m, spec_m],
+        out_specs=pl.BlockSpec((1, 1, kp, mp), lambda d, s: (d, s, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((D, S, kp, mp), RT.dtype),
+        scratch_shapes=[pltpu.VMEM((kp, mp), jnp.float32)],
+        interpret=interpret,
+    )(RT, BT, AT)
+
+
+def _prep_factors(Js, Cs, interpret=False):
+    """Pad + pre-transpose the chain factors ONCE per factorization.
+
+    Js, Cs: (D, S, m, m) inverse diagonal factors / sub-diagonal blocks
+    (`_block_chol(..., inv=True)` outputs, slab-stacked; D=1 unslabbed).
+    Returns a closure solving (D, S, m, k->) RHS chains for k <= 8."""
+    D, S, m, _ = Js.shape
+    mp = int(np.ceil(m / LANE) * LANE)
+    JsP = _pad_to(_pad_to(Js, mp, 2), mp, 3)
+    CsP = _pad_to(_pad_to(Cs, mp, 2), mp, 3)
+    JsT = jnp.swapaxes(JsP, -1, -2)
+    CsT = jnp.swapaxes(CsP, -1, -2)
+    # backward streams: B_t = C_{t+1} (within each chain), time-flipped
+    Cnext = jnp.concatenate([CsP[:, 1:], jnp.zeros_like(CsP[:, :1])], axis=1)
+    Cnext_rev = jnp.flip(Cnext, axis=1)
+    Js_rev = jnp.flip(JsP, axis=1)
+
+    def solve(r):
+        """r: (D, S, m) or (D, S, m, k). Returns same shape. k > 8 falls
+        back to the scan path (wide RHS is matmul-bound there already;
+        the fused kernel's payoff is the small-k latency case)."""
+        vec = r.ndim == 3
+        if vec:
+            r = r[..., None]
+        k = r.shape[-1]
+        if k > SUB:
+            from .structured import _bt_solve  # lazy: avoids import cycle
+
+            out = jax.vmap(partial(_bt_solve, inv=True))(Js, Cs, r)
+            return out[..., 0] if vec else out
+        kp = max(SUB, int(np.ceil(k / SUB) * SUB))
+        # row form: (D, S, kp, mp)
+        rT = jnp.swapaxes(_pad_to(r, mp, 2), -1, -2)
+        rT = _pad_to(rT, kp, 2)
+        vT = chain_sweep(rT, CsT, JsT, interpret=interpret)
+        xT = chain_sweep(
+            jnp.flip(vT, axis=1), Cnext_rev, Js_rev, interpret=interpret
+        )
+        x = jnp.swapaxes(jnp.flip(xT, axis=1), -1, -2)[:, :, :m, :k]
+        return x[..., 0] if vec else x
+
+    return solve

@@ -584,9 +584,17 @@ def _slab_chol(Ds, Es, D, mesh=None, axis="time", inv=False) -> _SlabFactors:
     return _SlabFactors(Ls_int, Cs_int, X, Y, Ls_schur, Cs_schur, E_prev, E_self)
 
 
-def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time", inv=False):
+def _slab_solve(
+    f: _SlabFactors, r, mesh=None, axis="time", inv=False,
+    chain_int=None, chain_schur=None,
+):
     """Solve using `_slab_chol` factors; r is (Tb, mB) or (Tb, mB, k).
-    `inv` must match the `_slab_chol` call that built `f`."""
+    `inv` must match the `_slab_chol` call that built `f`.
+
+    `chain_int` / `chain_schur` (optional callables taking the chain RHS
+    and returning the chain solution) override the interior / interface
+    sweep implementations — the hook the Pallas fused-sweep backend plugs
+    into (`solvers/pallas_sweep.py`); default is the `_bt_solve` scan."""
     vec = r.ndim == 2
     if vec:
         r = r[..., None]
@@ -597,11 +605,19 @@ def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time", inv=False):
     rr = r.reshape(D, S, mB, k)
     r_int, r_ifc = shard(rr[:, : S - 1]), rr[:, S - 1]
 
-    h = shard(jax.vmap(partial(_bt_solve, inv=inv))(f.Ls_int, f.Cs_int, r_int))
+    if chain_int is not None:
+        h = shard(chain_int(r_int))
+    else:
+        h = shard(
+            jax.vmap(partial(_bt_solve, inv=inv))(f.Ls_int, f.Cs_int, r_int)
+        )
     # interface RHS: g_d = r_I[d] - E_self[d] h[d, S-2] - E_prev[d+1]^T h[d+1, 0]
     g = r_ifc - jnp.einsum("dij,djk->dik", f.E_self, h[:, S - 2])
     g = g - _shift_up(jnp.einsum("dji,djk->dik", f.E_prev, h[:, 0]))
-    x_ifc = _bt_solve(f.Ls_schur, f.Cs_schur, g, inv=inv)  # (D, mB, k)
+    if chain_schur is not None:
+        x_ifc = chain_schur(g[None])[0]  # one D-step chain
+    else:
+        x_ifc = _bt_solve(f.Ls_schur, f.Cs_schur, g, inv=inv)  # (D, mB, k)
 
     # back-substitute: x_int = h - X x_I[d-1] - Y x_I[d]
     x_prev = _shift_down(x_ifc)
@@ -617,6 +633,7 @@ def _slab_solve(f: _SlabFactors, r, mesh=None, axis="time", inv=False):
 def _banded_ops(
     Ad, As, Bb, Tb, mB, nB, p, reg_d, pad_rows=None, slabs=None, mesh=None,
     chol_dtype=None, kkt_refine=0, fac_d_cap=None, inv_factors=False,
+    sweep_backend="xla",
 ):
     """(matvec, rmatvec, make_kkt_solver) for `ipm._solve_scaled`, operating
     on flat vectors laid out [Tb*nB time-cols | p border-cols] (x-space) and
@@ -699,21 +716,44 @@ def _banded_ops(
         Ds = Ds + jnp.einsum("tij,tj,tkj->tik", As_c, wprev_c, As_c)
         Ds = Ds + jax.vmap(jnp.diag)(diag_vec.astype(cd))
         Es = jnp.einsum("tij,tj,tkj->tik", As_c, wprev_c, _shift_down(Ad_c))
+        use_pallas = sweep_backend == "pallas"
+        inv = inv_factors or use_pallas  # pallas sweeps need inverse factors
+        if use_pallas:
+            from .pallas_sweep import _prep_factors
+
+            interp = jax.default_backend() != "tpu"
         if slabs:
-            fac = _slab_chol(Ds, Es, slabs, mesh=mesh, inv=inv_factors)
+            fac = _slab_chol(Ds, Es, slabs, mesh=mesh, inv=inv)
+            if use_pallas:
+                chain_int = _prep_factors(
+                    fac.Ls_int, fac.Cs_int, interpret=interp
+                )
+                chain_schur = _prep_factors(
+                    fac.Ls_schur[None], fac.Cs_schur[None], interpret=interp
+                )
+            else:
+                chain_int = chain_schur = None
 
             def chol_base(rt):
                 return _slab_solve(
-                    fac, rt.astype(cd), mesh=mesh, inv=inv_factors
+                    fac, rt.astype(cd), mesh=mesh, inv=inv,
+                    chain_int=chain_int, chain_schur=chain_schur,
                 ).astype(dtype)
 
         else:
-            Ls, Cs = _block_chol(Ds, Es, inv=inv_factors)
+            Ls, Cs = _block_chol(Ds, Es, inv=inv)
+            if use_pallas:
+                ps = _prep_factors(Ls[None], Cs[None], interpret=interp)
 
-            def chol_base(rt):
-                return _bt_solve(
-                    Ls, Cs, rt.astype(cd), inv=inv_factors
-                ).astype(dtype)
+                def chol_base(rt):
+                    return ps(rt.astype(cd)[None])[0].astype(dtype)
+
+            else:
+
+                def chol_base(rt):
+                    return _bt_solve(
+                        Ls, Cs, rt.astype(cd), inv=inv
+                    ).astype(dtype)
 
         if kkt_refine and cd != dtype:
             # K y = A_t W_t A_t^T y + diag_shift y, all in the full dtype;
@@ -816,13 +856,13 @@ def _ruiz_banded(Ad, As, Bb, iters: int = 8):
     jax.jit,
     static_argnames=(
         "meta", "max_iter", "refine_steps", "d_cap", "slabs", "mesh",
-        "chol_dtype", "kkt_refine", "inv_factors",
+        "chol_dtype", "kkt_refine", "inv_factors", "sweep_backend",
     ),
 )
 def _solve_banded_jit(
     meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs=None,
     mesh=None, chol_dtype=None, kkt_refine=0, fac_d_cap=None,
-    inv_factors=False,
+    inv_factors=False, sweep_backend="xla",
 ):
     Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0 = blp
     dtype = Ad.dtype
@@ -855,6 +895,7 @@ def _solve_banded_jit(
             pad_rows=meta.pad_rows, slabs=slabs, mesh=mesh,
             chol_dtype=chol_dtype, kkt_refine=kkt_refine,
             fac_d_cap=fac_d_cap, inv_factors=inv_factors,
+            sweep_backend=sweep_backend,
         )
         sol = _solve_scaled(
             LPData(
@@ -915,6 +956,7 @@ def solve_lp_banded(
     chol_dtype=None,
     kkt_refine: int = 0,
     inv_factors: bool = False,
+    sweep_backend: str = "xla",
 ) -> IPMSolution:
     """Solve a time-banded LP by the block-tridiagonal IPM. Returns a
     solution with ``x`` in the CompiledLP's reduced column order, so
@@ -956,7 +998,17 @@ def solve_lp_banded(
     while matvecs pipeline on the MXU. Same flop class, slightly
     different rounding (inverse-apply is not backward stable; the IPM's
     refine_steps/kkt_refine correct residuals) — accuracy vs the
-    substitution path is asserted in tests."""
+    substitution path is asserted in tests.
+
+    ``sweep_backend="pallas"`` runs every small-RHS KKT sweep chain as ONE
+    fused Pallas kernel (`solvers/pallas_sweep.py`): the carry vector
+    lives in VMEM across chain steps and each step streams its factor
+    blocks and issues two MXU matmuls — no per-step op dispatch, no carry
+    round-trips. Implies inverse factors; requires f32 factor work
+    (plain f32 data, or float64 with ``chol_dtype=float32``); not
+    combinable with ``mesh`` (multi-chip keeps the XLA sweeps). On
+    non-TPU backends the same kernel runs under the Pallas interpreter
+    (tests), so results are backend-independent."""
     dtype = blp.Ad.dtype
     if chol_dtype is not None:
         chol_dtype = jnp.dtype(chol_dtype)
@@ -980,6 +1032,20 @@ def solve_lp_banded(
             d_cap = 1e12  # f32 factor, no refinement: cap the solve
     elif d_cap is None and dtype != jnp.float64:
         d_cap = 1e12
+    if sweep_backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown sweep_backend {sweep_backend!r}")
+    if sweep_backend == "pallas":
+        if mesh is not None:
+            raise ValueError(
+                "sweep_backend='pallas' is single-chip; multi-chip (mesh) "
+                "keeps the XLA sweeps"
+            )
+        fac_dtype = jnp.dtype(chol_dtype) if chol_dtype is not None else dtype
+        if fac_dtype != jnp.float32:
+            raise ValueError(
+                "sweep_backend='pallas' needs f32 factor work (f32 data or "
+                f"chol_dtype=float32); factor dtype here is {fac_dtype}"
+            )
     if slabs:
         if meta.Tb % slabs or meta.Tb // slabs < 2:
             raise ValueError(
@@ -1011,7 +1077,7 @@ def solve_lp_banded(
             mesh = Mesh(mesh.devices, ("time",))
     return _solve_banded_jit(
         meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs,
-        mesh, chol_dtype, kkt_refine, fac_d_cap, inv_factors,
+        mesh, chol_dtype, kkt_refine, fac_d_cap, inv_factors, sweep_backend,
     )
 
 

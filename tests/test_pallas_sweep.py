@@ -1,0 +1,153 @@
+"""Pallas fused sweep-chain kernel (solvers/pallas_sweep.py).
+
+On CPU the kernel runs under the Pallas interpreter (`interpret=True` is
+forced off-TPU), so these tests pin kernel semantics — block indexing,
+carry reset per chain, pad/transpose/flip plumbing, k handling — not TPU
+codegen. The on-chip A/B lives in tools/bench_inv_factors.py.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.case_studies.renewables import params as P
+from dispatches_tpu.case_studies.renewables.pricetaker import (
+    HybridDesign,
+    build_pricetaker,
+)
+from dispatches_tpu.solvers.structured import (
+    _block_chol,
+    _bt_solve,
+    extract_time_structure,
+    solve_lp_banded,
+)
+from dispatches_tpu.solvers.pallas_sweep import _prep_factors
+
+DATA = P.load_rts303()
+
+
+def _random_chains(D, S, m, seed=5):
+    rng = np.random.default_rng(seed)
+    Js_all, Cs_all, r_all, ref_all = [], [], [], []
+    for _ in range(D):
+        Ds, Es = [], [np.zeros((m, m))]
+        for t in range(S):
+            M1 = rng.normal(0, 1, (m, m))
+            Ds.append(M1 @ M1.T + m * np.eye(m))
+            if t > 0:
+                Es.append(rng.normal(0, 0.3, (m, m)))
+        Ds = jnp.asarray(np.stack(Ds), jnp.float32)
+        Es = jnp.asarray(np.stack(Es), jnp.float32)
+        Js, Cs = _block_chol(Ds, Es, inv=True)
+        r = jnp.asarray(rng.normal(0, 1, (S, m)), jnp.float32)
+        Js_all.append(Js)
+        Cs_all.append(Cs)
+        r_all.append(r)
+        ref_all.append(_bt_solve(Js, Cs, r, inv=True))
+    return (
+        jnp.stack(Js_all),
+        jnp.stack(Cs_all),
+        jnp.stack(r_all),
+        jnp.stack(ref_all),
+        rng,
+    )
+
+
+@pytest.mark.parametrize("D,S,m", [(1, 12, 17), (4, 6, 33)])
+def test_chain_parity_with_scan(D, S, m):
+    """Fused kernel == scan path, incl. multi-chain grids (carry resets at
+    each chain start) and non-aligned m (pad plumbing)."""
+    Js, Cs, r, ref, rng = _random_chains(D, S, m)
+    solve = _prep_factors(Js, Cs, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(solve(r)), np.asarray(ref), atol=1e-4
+    )
+    # rank-3 RHS (the Woodbury border shape)
+    R = jnp.asarray(rng.normal(0, 1, (D, S, m, 3)), jnp.float32)
+    refR = jnp.stack(
+        [_bt_solve(Js[d], Cs[d], R[d], inv=True) for d in range(D)]
+    )
+    np.testing.assert_allclose(
+        np.asarray(solve(R)), np.asarray(refR), atol=1e-4
+    )
+
+
+def test_wide_rhs_falls_back_to_scan():
+    D, S, m = 2, 5, 9
+    Js, Cs, r, ref, rng = _random_chains(D, S, m, seed=7)
+    solve = _prep_factors(Js, Cs, interpret=True)
+    R = jnp.asarray(rng.normal(0, 1, (D, S, m, 2 * m)), jnp.float32)
+    refR = jnp.stack(
+        [_bt_solve(Js[d], Cs[d], R[d], inv=True) for d in range(D)]
+    )
+    np.testing.assert_allclose(
+        np.asarray(solve(R)), np.asarray(refR), atol=1e-5
+    )
+
+
+class TestIpmWithPallasSweeps:
+    def _setup(self, T=120):
+        design = HybridDesign(
+            T=T,
+            with_battery=True,
+            with_pem=True,
+            design_opt=True,
+            h2_price_per_kg=2.5,
+            initial_soc_fixed=None,
+        )
+        prog, _ = build_pricetaker(design)
+        p = {
+            "lmp": jnp.asarray(DATA["da_lmp"][:T], jnp.float32),
+            "wind_cf": jnp.asarray(DATA["da_wind_cf"][:T], jnp.float32),
+        }
+        meta = extract_time_structure(prog, T, block_hours=24)
+        return meta, meta.instantiate(p, dtype=jnp.float32)
+
+    def test_matches_xla_backend(self):
+        """Pure f32 at small T sits at the banded-f32 accuracy floor
+        (objective is vertex-sensitive; the backends differ only in
+        rounding path but can stop at different near-vertices) — so each
+        backend is held to the same band around the f64 truth rather
+        than to each other. The bit-tight backend comparison lives in
+        the mixed-precision test below, where refinement pins accuracy."""
+        from dispatches_tpu.solvers.reference import solve_lp_scipy_sparse
+
+        meta, blp = self._setup()
+        p64 = {
+            "lmp": jnp.asarray(DATA["da_lmp"][:120]),
+            "wind_cf": jnp.asarray(DATA["da_wind_cf"][:120]),
+        }
+        truth = solve_lp_scipy_sparse(meta.prog, p64).obj_with_offset
+        kw = dict(tol=1e-5, max_iter=40, refine_steps=2)
+        ref = solve_lp_banded(meta, blp, inv_factors=True, **kw)
+        pal = solve_lp_banded(meta, blp, sweep_backend="pallas", **kw)
+        assert float(ref.obj) == pytest.approx(truth, rel=2e-2)
+        assert float(pal.obj) == pytest.approx(truth, rel=2e-2)
+
+    def test_matches_xla_backend_slabbed(self):
+        meta, blp = self._setup(T=240)  # Tb=10 -> 5 slabs of 2
+        kw = dict(tol=1e-5, max_iter=40, refine_steps=2, slabs=5)
+        ref = solve_lp_banded(meta, blp, inv_factors=True, **kw)
+        pal = solve_lp_banded(meta, blp, sweep_backend="pallas", **kw)
+        assert float(pal.obj) == pytest.approx(float(ref.obj), rel=1e-3)
+
+    def test_guards(self):
+        meta, blp = self._setup()
+        p64 = {
+            "lmp": jnp.asarray(DATA["da_lmp"][:120]),
+            "wind_cf": jnp.asarray(DATA["da_wind_cf"][:120]),
+        }
+        blp64 = meta.instantiate(p64, dtype=jnp.float64)
+        with pytest.raises(ValueError, match="f32 factor work"):
+            solve_lp_banded(meta, blp64, sweep_backend="pallas")
+        with pytest.raises(ValueError, match="unknown sweep_backend"):
+            solve_lp_banded(meta, blp, sweep_backend="mosaic")
+        # mixed precision IS allowed: f64 data with f32 factors
+        sol = solve_lp_banded(
+            meta, blp64, sweep_backend="pallas",
+            chol_dtype=jnp.float32, kkt_refine=1, tol=1e-7, max_iter=40,
+        )
+        ref = solve_lp_banded(
+            meta, blp64, inv_factors=True,
+            chol_dtype=jnp.float32, kkt_refine=1, tol=1e-7, max_iter=40,
+        )
+        assert float(sol.obj) == pytest.approx(float(ref.obj), rel=1e-3)

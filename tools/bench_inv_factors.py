@@ -1,13 +1,18 @@
-"""On-chip A/B: year-solve with substitution vs inverse block factors.
+"""On-chip A/B/C: year-solve sweep backends.
 
 The 8,760-h banded IPM measured 12.7 s on the chip (BENCH_NOTES.md) —
 ~2% of the chip's matmul peak for the flop count — and the prime suspect
 is the solve phase: ~8 rank-1 KKT solves per IPM iteration, each a
 sequential chain of small triangular solves, which TPUs execute at
-latency, not throughput. `inv_factors=True` (solvers/structured.py)
-stores L_t^{-1} instead of L_t so every sweep step is a matmul.
+latency, not throughput. Three modes:
 
-Run on the real TPU (no driver involvement):
+- sub:    stored L factors, scan of rank-1 triangular solves (baseline)
+- inv:    `inv_factors=True` — stored L^{-1}, scan of matvecs
+- pallas: `sweep_backend="pallas"` — whole sweep chains fused into one
+          Pallas kernel, carry in VMEM (solvers/pallas_sweep.py)
+
+A mode that fails (e.g. Mosaic unsupported on this backend) records the
+error and the others still report. Run on the real TPU:
     python tools/bench_inv_factors.py
 Prints one timing line per mode + accuracy vs host HiGHS, and appends a
 JSON record to INV_FACTORS_AB.json.
@@ -64,15 +69,19 @@ def main():
         {"lmp": jnp.asarray(ylmp), "wind_cf": jnp.asarray(ycf)},
     ).obj_with_offset
     rows = {}
-    for inv in (False, True):
-        label = "inv" if inv else "sub"
+    for label, extra in (
+        ("sub", {}),
+        ("inv", dict(inv_factors=True)),
+        ("pallas", dict(sweep_backend="pallas")),
+    ):
+      try:
         blp = meta.instantiate(
             {"lmp": jnp.asarray(ylmp, jnp.float32),
              "wind_cf": jnp.asarray(ycf, jnp.float32)},
             dtype=jnp.float32,
         )
         t0 = time.perf_counter()
-        sol = solve_lp_banded(meta, blp, inv_factors=inv, **kw)
+        sol = solve_lp_banded(meta, blp, **extra, **kw)
         np.asarray(sol.obj)
         warm = time.perf_counter() - t0
         # timed run on jittered inputs (tunnel memoization guard)
@@ -83,7 +92,7 @@ def main():
             dtype=jnp.float32,
         )
         t0 = time.perf_counter()
-        sol2 = solve_lp_banded(meta, blp2, inv_factors=inv, **kw)
+        sol2 = solve_lp_banded(meta, blp2, **extra, **kw)
         obj = float(np.asarray(sol2.obj))
         dt = time.perf_counter() - t0
         err = abs(obj - ref) / (1 + abs(ref))
@@ -99,9 +108,14 @@ def main():
             f" iters={rows[label]['iterations']} rel_err={err:.1e}",
             flush=True,
         )
-    rows["speedup_inv_over_sub"] = round(
-        rows["sub"]["seconds"] / rows["inv"]["seconds"], 2
-    )
+      except Exception as e:  # a failed mode must not kill the others
+        rows[label] = {"error": f"{type(e).__name__}: {e}"[:2000]}
+        print(f"{label}: FAILED {type(e).__name__}: {str(e)[:200]}",
+              flush=True)
+    for a, b, key in (("sub", "inv", "speedup_inv_over_sub"),
+                      ("sub", "pallas", "speedup_pallas_over_sub")):
+        if "seconds" in rows.get(a, {}) and "seconds" in rows.get(b, {}):
+            rows[key] = round(rows[a]["seconds"] / rows[b]["seconds"], 2)
     rows["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     hist = []
     if os.path.exists(OUT):
