@@ -857,12 +857,13 @@ def _ruiz_banded(Ad, As, Bb, iters: int = 8):
     static_argnames=(
         "meta", "max_iter", "refine_steps", "d_cap", "slabs", "mesh",
         "chol_dtype", "kkt_refine", "inv_factors", "sweep_backend",
+        "correctors",
     ),
 )
 def _solve_banded_jit(
     meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs=None,
     mesh=None, chol_dtype=None, kkt_refine=0, fac_d_cap=None,
-    inv_factors=False, sweep_backend="xla",
+    inv_factors=False, sweep_backend="xla", correctors=0,
 ):
     Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0 = blp
     dtype = Ad.dtype
@@ -914,6 +915,7 @@ def _solve_banded_jit(
             None,
             ops=ops,
             d_cap=d_cap,
+            correctors=correctors,
         )
         # unscale and map back to the CompiledLP's reduced column order
         x_flat = sol.x * cs_all * sig_b
@@ -957,6 +959,7 @@ def solve_lp_banded(
     kkt_refine: int = 0,
     inv_factors: bool = False,
     sweep_backend: str = "xla",
+    correctors: int = 0,
 ) -> IPMSolution:
     """Solve a time-banded LP by the block-tridiagonal IPM. Returns a
     solution with ``x`` in the CompiledLP's reduced column order, so
@@ -1078,6 +1081,7 @@ def solve_lp_banded(
     return _solve_banded_jit(
         meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs,
         mesh, chol_dtype, kkt_refine, fac_d_cap, inv_factors, sweep_backend,
+        correctors,
     )
 
 

@@ -152,3 +152,51 @@ class TestTerminationDiagnosis:
         s = solve_lp_batch(lp, tol=1e-8, max_iter=60)
         assert int(s.status[0]) == STATUS_OPTIMAL
         assert int(s.status[1]) == STATUS_PRIMAL_INFEASIBLE
+
+
+class TestGondzioCorrectors:
+    """`correctors=K`: Gondzio multiple centrality correctors — extra
+    pure-complementarity solves reusing each iteration's factorization.
+    Opt-in (default 0 preserves every existing recipe). Measured on the
+    weekly design LPs: ~9% fewer iterations at one extra O(m^2) solve per
+    corrector vs the O(m^3) factorization per iteration."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_same_solution_random(self, seed):
+        rng = np.random.default_rng(seed)
+        A, b, c, l, u = random_lp(rng)
+        lp = LPData(
+            A=jnp.asarray(A), b=jnp.asarray(b), c=jnp.asarray(c),
+            l=jnp.asarray(l), u=jnp.asarray(u), c0=jnp.asarray(0.0),
+        )
+        s0 = solve_lp(lp, tol=1e-9)
+        s2 = solve_lp(lp, tol=1e-9, correctors=2)
+        assert bool(s2.converged)
+        assert float(s2.obj) == pytest.approx(float(s0.obj), rel=1e-7)
+
+    def test_reduces_iterations_on_design_lp(self):
+        from dispatches_tpu.case_studies.renewables import params as P
+        from dispatches_tpu.case_studies.renewables.pricetaker import (
+            HybridDesign,
+            build_pricetaker,
+        )
+
+        T = 168
+        design = HybridDesign(
+            T=T, with_battery=True, with_pem=True, design_opt=True,
+            h2_price_per_kg=2.5, initial_soc_fixed=None,
+        )
+        prog, _ = build_pricetaker(design)
+        data = P.load_rts303()
+        lp = prog.instantiate(
+            {"lmp": jnp.asarray(data["da_lmp"][:T]),
+             "wind_cf": jnp.asarray(data["da_wind_cf"][:T])}
+        )
+        s0 = solve_lp(lp, tol=1e-8)
+        s2 = solve_lp(lp, tol=1e-8, correctors=2)
+        assert bool(s0.converged) and bool(s2.converged)
+        assert float(s2.obj) == pytest.approx(float(s0.obj), rel=1e-6)
+        # correctors should not take more iterations (measured: 21 -> 19
+        # on this LP); +1 slack absorbs cross-backend iteration drift (the
+        # acceptance rule guarantees per-iteration step size, not totals)
+        assert int(s2.iterations) <= int(s0.iterations) + 1
