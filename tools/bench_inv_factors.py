@@ -73,6 +73,9 @@ def main():
         ("sub", {}),
         ("inv", dict(inv_factors=True)),
         ("pallas", dict(sweep_backend="pallas")),
+        # Gondzio correctors on the fastest-so-far sweep mode: fewer
+        # iterations at one extra solve each (see solvers/ipm.py)
+        ("inv+corr2", dict(inv_factors=True, correctors=2)),
     ):
       try:
         blp = meta.instantiate(
