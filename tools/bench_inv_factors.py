@@ -116,7 +116,8 @@ def main():
         print(f"{label}: FAILED {type(e).__name__}: {str(e)[:200]}",
               flush=True)
     for a, b, key in (("sub", "inv", "speedup_inv_over_sub"),
-                      ("sub", "pallas", "speedup_pallas_over_sub")):
+                      ("sub", "pallas", "speedup_pallas_over_sub"),
+                      ("inv", "inv+corr2", "speedup_corr2_over_inv")):
         if "seconds" in rows.get(a, {}) and "seconds" in rows.get(b, {}):
             rows[key] = round(rows[a]["seconds"] / rows[b]["seconds"], 2)
     rows["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
