@@ -177,6 +177,8 @@ def run_year_sweep(
     seed: int = 0,
     dtype: str = "float64",
     mixed_precision: bool = True,
+    correctors: int = 0,
+    inv_factors: bool = False,
     store_path: Optional[str] = None,
     verbose: bool = True,
 ):
@@ -191,7 +193,10 @@ def run_year_sweep(
 
     `mixed_precision` (f64 data, f32 factors + refined directions) gives
     ~1e-3-accurate year NPVs at f32 factorization cost; `dtype="float32"`
-    is the pure-f32 chip regime (~1% NPV floor). Scenario draws are
+    is the pure-f32 chip regime (~1% NPV floor). `correctors` (Gondzio)
+    and `inv_factors` are the solver-throughput knobs of
+    `solve_lp_banded` — pair correctors with mixed precision, not pure
+    f32 (docs/solvers.md). Scenario draws are
     deterministic in `seed`, so the ResultStore checkpoint keys stay
     aligned across resumed runs (solved scenarios are skipped)."""
     import jax
@@ -229,7 +234,10 @@ def run_year_sweep(
     rng = np.random.default_rng(seed)
     scales = rng.uniform(*lmp_scale_range, scenarios)
 
-    solver_kw = dict(tol=1e-6, max_iter=80, refine_steps=3)
+    solver_kw = dict(
+        tol=1e-6, max_iter=80, refine_steps=3,
+        correctors=correctors, inv_factors=inv_factors,
+    )
     if mixed_precision and jdtype == jnp.float64:
         solver_kw.update(chol_dtype=jnp.float32, kkt_refine=1)
 
@@ -249,6 +257,8 @@ def run_year_sweep(
             h2_price,
             str(jdtype),
             1.0 if (mixed_precision and jdtype == jnp.float64) else 0.0,
+            float(correctors),
+            1.0 if inv_factors else 0.0,
         )
         for k in range(scenarios)
     }
@@ -407,6 +417,10 @@ def main(argv=None):
     ys.add_argument("--seed", type=int, default=0)
     ys.add_argument("--dtype", choices=("float64", "float32"), default="float64")
     ys.add_argument("--no-mixed-precision", action="store_true")
+    ys.add_argument("--correctors", type=int, default=0,
+                    help="Gondzio centrality correctors per IPM iteration")
+    ys.add_argument("--inv-factors", action="store_true",
+                    help="store block factors as inverses (TPU sweep speed)")
     ys.add_argument("--out", default=None, help="ResultStore checkpoint path")
     ys.add_argument(
         "--platform", choices=("default", "cpu"), default="default",
@@ -455,6 +469,8 @@ def main(argv=None):
             seed=args.seed,
             dtype=args.dtype,
             mixed_precision=not args.no_mixed_precision,
+            correctors=args.correctors,
+            inv_factors=args.inv_factors,
             store_path=args.out,
         )
     return 0

@@ -164,6 +164,17 @@ class TestRunners:
             store_path=str(store), verbose=False,
         )
         assert out2 == []
+        # the solver-throughput knobs thread through and agree on NPV
+        out3 = run_year_sweep(
+            scenarios=2, batch=2, hours=192, h2_price=2.5,
+            correctors=2, inv_factors=True, verbose=False,
+        )
+        assert all(r["converged"] for r in out3)
+        ref = {round(r["lmp_scale"], 9): r["NPV"] for r in out}
+        for r in out3:
+            assert r["NPV"] == pytest.approx(
+                ref[round(r["lmp_scale"], 9)], rel=1e-3
+            )
 
 
 class TestTelemetry:
