@@ -348,7 +348,7 @@ def _year_batch_child(npz_path, By):
     print(json.dumps(out), flush=True)
 
 
-def _run_year_batch_via_child(ylmp, ycf, By0):
+def _run_year_batch_via_child(ylmp, ycf, By0, scales=None):
     """Try the year-batch row at By0 in an isolated child process.
 
     Failure policy (the child can die three ways):
@@ -360,9 +360,16 @@ def _run_year_batch_via_child(ylmp, ycf, By0):
       may still land a row; the stderr tail is preserved either way).
     A total wall budget bounds the worst case (hang mode burns the full
     per-child timeout each attempt). Returns the child's result dict or
-    {"failed": True, "fallback_errors": [...]}."""
-    rng = np.random.default_rng(time.time_ns() % (2**32))
-    scales = rng.uniform(0.7, 1.4, max(By0, 1)).astype(np.float32)
+    {"failed": True, "fallback_errors": [...]}.
+
+    `scales` overrides the random LMP-scale draw — the year-sweep tool
+    (tools/run_yearsweep_tpu.py) passes its deterministic scenario scales
+    through this same fallback machinery."""
+    if scales is None:
+        rng = np.random.default_rng(time.time_ns() % (2**32))
+        scales = rng.uniform(0.7, 1.4, max(By0, 1)).astype(np.float32)
+    else:
+        scales = np.asarray(scales, np.float32)
     # pid-suffixed scratch: concurrent bench runs (a background watch loop
     # plus the driver's capture run) must not clobber each other's inputs
     # or pick up each other's results

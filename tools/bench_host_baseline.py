@@ -1,0 +1,307 @@
+"""Host-side denominators for the chip benchmark rows (no tunnel needed).
+
+VERDICT r4 missing #3: "no artifact anywhere records host-HiGHS solves/s
+on the bench's own LPs". This tool measures, on the host CPU:
+
+- HiGHS solve seconds / solves-per-sec on the bench's exact weekly LP
+  family (T=168 wind+battery+PEM design LP — the same `prog.instantiate`
+  the chip's weekly row vmaps over; reference shells out to this solver
+  class per scenario, `wind_battery_LMP.py:266`);
+- HiGHS wall seconds on the bench's exact monolithic 8,760-h design LP
+  (reference anchor: `price_taker_analysis.py:181-224`, CPU-only);
+- a first-order FLOP/iteration model for both chip solve paths, built
+  from the *instantiated problem dims* (dense normal-equations IPM for
+  weekly; 73-h-block SPIKE banded IPM for the year);
+- MFU estimates for measured chip stage times. Chip seconds are read
+  from BENCH_LOCAL.json rows when a round-5 capture exists, else from
+  the round-4 HEAD-committed BENCH_DIAG stage_times (weekly B=416 in
+  30.276 s; year in 12.68 s — BENCH_NOTES.md). The peak denominator
+  prefers a measured MATMUL_PEAK.json (tools/measure_matmul_peak.py, run
+  on-chip by the watch loop); until that exists it falls back to an
+  ASSUMED f32 peak, and the JSON says which was used.
+
+Writes BASELINE_HOST.json. Run anywhere: forces the host platform
+in-process (the ambient sitecustomize would otherwise route to the
+tunnel and hang — memory: sitecustomize-forces-axon).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp  # noqa: E402
+
+from dispatches_tpu.case_studies.renewables import params as P  # noqa: E402
+from dispatches_tpu.case_studies.renewables.pricetaker import (  # noqa: E402
+    HybridDesign,
+    build_pricetaker,
+)
+from dispatches_tpu.solvers.reference import (  # noqa: E402
+    solve_lp_scipy,
+    solve_lp_scipy_sparse,
+)
+from dispatches_tpu.solvers.structured import extract_time_structure  # noqa: E402
+
+OUT = os.path.join(REPO, "BASELINE_HOST.json")
+
+# Round-4 chip anchors (the only on-chip measurements that exist at
+# round-5 start), snapshotted in BENCH_R4_CHIP_ANCHORS.json. Provenance:
+# the BENCH_DIAG.json committed at fcf353e — NOT at round-4 HEAD 52fb786,
+# whose BENCH_DIAG was overwritten by a later outage's probe failures.
+R4_SRC = (
+    "BENCH_R4_CHIP_ANCHORS.json (BENCH_DIAG stage_times @ commit "
+    "fcf353e, 2026-07-31 03:49-04:09 UTC live window)"
+)
+R4_CHIP = {
+    "weekly": {"batch": 416, "seconds": 30.276, "median_iters": None},
+    "year_single": {"seconds": 12.68, "iterations": None},
+}
+
+# Fallback peak when no measured MATMUL_PEAK.json exists. The tunnel's
+# chip reports as a single v5e-class device; v5e peak is 197 TFLOP/s
+# bf16, and f32 matmul on the MXU lands at roughly 1/4 of bf16 — call
+# it ~49 TFLOP/s. This is an ASSUMPTION (flagged in the output);
+# tools/measure_matmul_peak.py replaces it with a measurement.
+ASSUMED_F32_PEAK_TFLOPS = 49.0
+
+
+def _design(T):
+    return HybridDesign(
+        T=T,
+        with_battery=True,
+        with_pem=True,
+        design_opt=True,
+        h2_price_per_kg=2.5,
+        initial_soc_fixed=None,
+    )
+
+
+def weekly_flops_per_iter(M, N):
+    """Dense normal-equations IPM cost per iteration for one weekly LP.
+
+    solvers/ipm.py solves (A W A^T + dI) dy = r by forming the product
+    and one Cholesky per iteration, then ~10 triangular solve pairs
+    (predictor + corrector + refinement right-hand sides):
+      form A W A^T : 2 M^2 N   (the W scaling is O(MN), ignored)
+      Cholesky     : M^3 / 3
+      solves       : 10 * 2 M^2
+    """
+    return 2.0 * M * M * N + M**3 / 3.0 + 20.0 * M * M
+
+
+def banded_flops_per_iter(Tb, mB, nB, p, n_sweeps=8):
+    """Block-tridiagonal SPIKE IPM cost per iteration for one year LP.
+
+    solvers/structured.py factorizes Tb diagonal blocks of the normal
+    equations and runs ~n_sweeps rank-1 forward+backward block sweeps:
+      form block products (diag + sub-diag A W A^T) : ~6 Tb mB^2 nB
+      block Cholesky                                : Tb mB^3 / 3
+      sub-diagonal couplings C_t = L^-1 S           : 2 Tb mB^3
+      sweeps (fwd+bwd triangular per block)         : n_sweeps * 4 Tb mB^2
+      border (Woodbury rank p)                      : ~4 Tb mB^2 p
+    """
+    return (
+        6.0 * Tb * mB * mB * nB
+        + Tb * mB**3 / 3.0
+        + 2.0 * Tb * mB**3
+        + n_sweeps * 4.0 * Tb * mB * mB
+        + 4.0 * Tb * mB * mB * p
+    )
+
+
+def main():
+    rec = {"host": {}, "flop_model": {}, "chip_mfu": {}}
+
+    # ---- weekly family: host HiGHS ----
+    T, n_cpu = 168, 8
+    data = P.load_rts303()
+    prog, _ = build_pricetaker(_design(T))
+    lmp_weeks = data["da_lmp"].reshape(52, T)
+    cf_weeks = data["da_wind_cf"].reshape(52, T)
+    rng = np.random.default_rng(0)
+    scales = rng.uniform(0.5, 2.0, n_cpu)
+    lps = [
+        prog.instantiate(
+            {
+                "lmp": jnp.asarray(scales[k] * lmp_weeks[k % 52], jnp.float64),
+                "wind_cf": jnp.asarray(cf_weeks[k % 52], jnp.float64),
+            }
+        )
+        for k in range(n_cpu)
+    ]
+    M, N = (int(d) for d in lps[0].A.shape)
+    solve_lp_scipy(lps[0])  # warm scipy import/first-call costs
+    per_solve = []
+    for lp in lps:
+        t0 = time.perf_counter()
+        solve_lp_scipy(lp)
+        per_solve.append(time.perf_counter() - t0)
+    # median, not mean: host load spikes (this box runs watch loops and
+    # test suites) skew the mean ~30% run-to-run
+    wk_dt = float(np.median(per_solve))
+    rec["host"]["weekly"] = {
+        "lp_rows": M,
+        "lp_cols": N,
+        "n_solved": n_cpu,
+        "seconds_per_solve_median": round(wk_dt, 4),
+        "seconds_per_solve_min": round(min(per_solve), 4),
+        "seconds_per_solve_max": round(max(per_solve), 4),
+        "highs_solves_per_sec": round(1.0 / wk_dt, 3),
+        "note": "dense-interface HiGHS on the identical weekly LPs the "
+        "chip row vmaps; the reference additionally pays a Pyomo rebuild "
+        "+ solver subprocess per solve (wind_battery_LMP.py:195-267)",
+    }
+
+    # ---- year LP: host HiGHS (sparse) ----
+    Ty = 8760
+    yprog, _ = build_pricetaker(_design(Ty))
+    # mirror bench.py's year-input construction (tiled LMP x ±5% uniform
+    # jitter) with a FIXED seed: bench's own draw is time-seeded, so this
+    # is the same LP family and a statistically matched instance, not the
+    # byte-identical cost vector of any particular chip run
+    yrng = np.random.default_rng(0)
+    ylmp = np.resize(data["da_lmp"], Ty) * yrng.uniform(0.95, 1.05, Ty)
+    ycf = np.resize(data["da_wind_cf"], Ty)
+    t0 = time.perf_counter()
+    ysol = solve_lp_scipy_sparse(
+        yprog,
+        {"lmp": jnp.asarray(ylmp, jnp.float64),
+         "wind_cf": jnp.asarray(ycf, jnp.float64)},
+    )
+    y_dt = time.perf_counter() - t0
+    ymeta = extract_time_structure(yprog, Ty, block_hours=73)
+    rec["host"]["year_single"] = {
+        "seconds": round(y_dt, 2),
+        "objective": float(ysol.obj_with_offset),
+        "note": "scipy HiGHS (sparse) on the same monolithic 8,760-h "
+        "design-LP family the chip's year row solves (same structure and "
+        "jitter distribution; the bench's instance differs by its "
+        "time-seeded ±5% LMP draw)",
+    }
+
+    # ---- FLOP models from the instantiated dims ----
+    wk_fpi = weekly_flops_per_iter(M, N)
+    Tb, mB, nB, p = ymeta.Tb, ymeta.mB, ymeta.nB, ymeta.p
+    yr_fpi = banded_flops_per_iter(Tb, mB, nB, p)
+    rec["flop_model"] = {
+        "weekly_per_iter_per_lp": wk_fpi,
+        "weekly_dims": {"M": M, "N": N},
+        "year_per_iter": yr_fpi,
+        "year_dims": {"Tb": int(Tb), "mB": int(mB), "nB": int(nB),
+                      "p": int(p)},
+        "method": "first-order dominant-term counts; see "
+        "weekly_flops_per_iter / banded_flops_per_iter docstrings",
+    }
+
+    # ---- chip MFU: prefer a fresh BENCH_LOCAL capture, else r4 anchors.
+    # Source is tracked PER ROW: a partial capture (e.g. only the weekly
+    # row flushed before an outage) must not relabel the stale row.
+    chip = {k: dict(v, source=R4_SRC) for k, v in R4_CHIP.items()}
+    try:
+        with open(os.path.join(REPO, "BENCH_LOCAL.json")) as f:
+            loc = json.load(f)
+        rows = loc.get("rows", {})
+        loc_src = f"BENCH_LOCAL.json ({loc.get('ts')})"
+        # adopt a fresh row ONLY if its quality gates passed: bench.py
+        # flushes timings BEFORE its gates run, so an ungated row here
+        # would publish MFU/speedups for non-converged (round-1 "679k
+        # solves/sec at converged=0") or wrong-objective solves — require
+        # BOTH convergence and the HiGHS accuracy cross-check
+        wk = rows.get("weekly", {})
+        if (
+            "solves_per_sec" in wk
+            and wk.get("converged", 0.0) >= 0.99
+            and wk.get("rel_err_vs_highs", np.inf) < 1e-3
+        ):
+            chip["weekly"] = {
+                "batch": wk["batch"],
+                "seconds": wk["seconds"],
+                "median_iters": wk.get("median_iters"),
+                "source": loc_src,
+            }
+        ys = rows.get("year_single", {})
+        if "seconds" in ys and ys.get("gate_ok"):
+            chip["year_single"] = {
+                "seconds": ys["seconds"],
+                "iterations": ys.get("iterations"),
+                "source": loc_src,
+            }
+    except FileNotFoundError:
+        pass  # no round-5 capture yet; r4 anchors stand
+    except Exception as e:
+        print(f"warning: BENCH_LOCAL.json unreadable ({e}); "
+              "using r4 anchors", file=sys.stderr)
+
+    peak_tflops, peak_src = ASSUMED_F32_PEAK_TFLOPS, (
+        f"ASSUMED v5e f32 ~{ASSUMED_F32_PEAK_TFLOPS:.0f} TFLOP/s "
+        "(no measured MATMUL_PEAK.json yet)"
+    )
+    try:
+        with open(os.path.join(REPO, "MATMUL_PEAK.json")) as f:
+            mp = json.load(f)
+        peak_tflops = mp["achieved_f32_tflops"]
+        peak_src = f"measured MATMUL_PEAK.json ({mp.get('ts')})"
+    except Exception:
+        pass
+
+    # iteration counts: measured medians when a capture recorded them;
+    # else the host HiGHS-free IPM typical range observed in tests (~35
+    # for weekly f32 @ tol 1e-6, ~45 for the year banded f32 @ 1e-5) —
+    # flagged as assumed
+    wk_iters = chip["weekly"].get("median_iters") or 35.0
+    yr_iters = chip["year_single"].get("iterations") or 45.0
+    wk_tflops = (
+        chip["weekly"]["batch"] * wk_iters * wk_fpi
+        / chip["weekly"]["seconds"] / 1e12
+    )
+    yr_tflops = yr_iters * yr_fpi / chip["year_single"]["seconds"] / 1e12
+    rec["chip_mfu"] = {
+        "peak_source": peak_src,
+        "peak_f32_tflops": peak_tflops,
+        "weekly": {
+            **chip["weekly"],
+            "iters_used": wk_iters,
+            "iters_assumed": chip["weekly"].get("median_iters") is None,
+            "achieved_tflops": round(wk_tflops, 3),
+            "mfu": round(wk_tflops / peak_tflops, 5),
+        },
+        "year_single": {
+            **chip["year_single"],
+            "iters_used": yr_iters,
+            "iters_assumed": chip["year_single"].get("iterations") is None,
+            "achieved_tflops": round(yr_tflops, 3),
+            "mfu": round(yr_tflops / peak_tflops, 5),
+        },
+    }
+
+    # ---- the ratios the verdict asked for ----
+    # (chip rows always exist: gated BENCH_LOCAL rows, else the r4 anchors)
+    chip_sps = chip["weekly"]["batch"] / chip["weekly"]["seconds"]
+    rec["chip_vs_host"] = {
+        "weekly_chip_solves_per_sec": round(chip_sps, 2),
+        "weekly_host_highs_solves_per_sec": round(1.0 / wk_dt, 3),
+        "weekly_speedup_per_chip_vs_per_core": round(chip_sps * wk_dt, 1),
+        "year_chip_seconds": chip["year_single"]["seconds"],
+        "year_host_highs_seconds": round(y_dt, 2),
+        "year_speedup": round(y_dt / chip["year_single"]["seconds"], 2),
+    }
+
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    tmp = OUT + f".{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(tmp, OUT)
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()

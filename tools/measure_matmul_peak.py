@@ -1,0 +1,101 @@
+"""Measure the chip's achievable f32 matmul rate (the MFU denominator).
+
+BASELINE_HOST.json's MFU rows need a peak-FLOPs denominator. Spec sheets
+for this tunnel's chip are ambiguous (bf16 vs f32 MXU rates differ by
+4-8x), so measure it: time a big f32 matmul (the same dtype the solver
+tier runs in) at a few sizes and keep the best rate. Anti-memoization
+jitter on the inputs (the tunnel caches (executable, inputs) -> outputs
+across processes — memory: axon-tunnel-failure-modes).
+
+Writes MATMUL_PEAK.json. Run on the real chip (watch-loop stage).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "MATMUL_PEAK.json")
+
+
+def _with_watchdog(fn, timeout_s=300.0):
+    """Tunnel hang mode blocks device calls forever at 0% CPU; a hung
+    probe must time out (and fail this tool) instead of wedging the
+    watch-loop slot that runs it. Daemon thread, same pattern as
+    bench.py's _device (the stuck thread can't be killed, but the
+    process can move on and exit)."""
+    import queue
+    import threading
+
+    q = queue.Queue()
+
+    def worker():
+        try:
+            q.put(("ok", fn()))
+        except Exception as exc:
+            q.put(("err", exc))
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        kind, val = q.get(timeout=timeout_s)
+    except queue.Empty:
+        raise TimeoutError(f"device call hung > {timeout_s:.0f}s")
+    if kind == "err":
+        raise val
+    return val
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(time.time_ns() % (2**32))
+    rows = []
+    best = 0.0
+    for n in (2048, 4096, 8192):
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+        # reduce on-device and fetch ONE scalar: pulling the n x n product
+        # across the tunnel (268 MB at n=8192) would make the measurement
+        # transfer-dominated and deflate every MFU that divides by it
+        f = jax.jit(lambda x, y: (x @ y).sum())
+        # compile + first run (watchdogged: compile is the likeliest hang)
+        _with_watchdog(lambda: float(np.asarray(f(a, b))), timeout_s=600.0)
+        # timed: fresh jittered inputs PER REP (identical inputs rep-to-rep
+        # could be served from the tunnel's memoization cache), one
+        # scalar-fetch sync per rep
+        reps = 3
+        a2s = [a * np.float32(1.0 + rng.uniform(1e-6, 1e-5))
+               for _ in range(reps)]
+        t0 = time.perf_counter()
+        for a2 in a2s:
+            _with_watchdog(lambda a2=a2: float(np.asarray(f(a2, b))))
+        dt = (time.perf_counter() - t0) / reps
+        tflops = 2.0 * n**3 / dt / 1e12
+        rows.append({"n": n, "seconds": round(dt, 4),
+                     "achieved_f32_tflops": round(tflops, 2)})
+        best = max(best, tflops)
+        print(f"n={n}: {dt * 1e3:.1f} ms -> {tflops:.1f} TFLOP/s f32",
+              flush=True)
+    rec = {
+        "achieved_f32_tflops": round(best, 2),
+        "sizes": rows,
+        "devices": [str(d) for d in jax.devices()],
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "note": "best steady-state rate over square f32 matmuls, synced "
+        "by an on-device sum + scalar fetch (result matrices never cross "
+        "the tunnel); the MFU denominator in BASELINE_HOST.json — the "
+        "achievable-in-practice ceiling incl. per-call dispatch latency, "
+        "not the silicon ceiling",
+    }
+    tmp = OUT + f".{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(tmp, OUT)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
