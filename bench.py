@@ -149,7 +149,7 @@ def _flush_local():
     _atomic_dump(_LOCAL, os.path.join(REPO, "BENCH_LOCAL.json"))
 
 
-def _fail(stage, n_attempts):
+def _fail(stage, n_attempts, fatal_fast=False):
     _write_diag(stage)
     # a capture-time outage must not hide that the chip DID work earlier:
     # point at the last measured rows (this run's partial flushes, or a
@@ -186,12 +186,24 @@ def _fail(stage, n_attempts):
             "; earlier in-session measurements, if any, are in "
             "BENCH_NOTES.md / BENCH_DIAG.json stage_times"
         )
+    # the failure record must state what actually happened: the
+    # fatal-fast path (poisoned PJRT client after a worker crash) gives
+    # up the moment the crash signature appears — which may be attempt 1
+    # (no backoff at all) or a later attempt (after the backoff that
+    # preceded it); report the backoff actually slept, not the full table
+    if fatal_fast:
+        slept = sum(_DELAYS[: max(n_attempts - 1, 0)])
+        how = (
+            f"gave up immediately on attempt {n_attempts} (worker crash "
+            f"poisons the client; {slept}s backoff slept before it)"
+        )
+    else:
+        how = f"after {n_attempts} attempts over {sum(_DELAYS)}s backoff"
     print(
         json.dumps(
             {
                 "metric": f"BENCH FAILED: device unavailable at stage "
-                f"'{stage}' after {n_attempts} attempts over "
-                f"{sum(_DELAYS)}s backoff (diagnostics: BENCH_DIAG.json)"
+                f"'{stage}' {how} (diagnostics: BENCH_DIAG.json)"
                 + prior,
                 "value": 0.0,
                 "unit": "error",
@@ -269,7 +281,7 @@ def _device(stage, fn, timeout_s=900.0):
                 continue  # retryable by definition
             if any(pat in msg.lower() for pat in _FATAL_FAST):
                 _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
-                _fail(stage, i + 1)
+                _fail(stage, i + 1, fatal_fast=True)
             if not any(pat in msg.lower() for pat in _RETRYABLE):
                 _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
                 raise

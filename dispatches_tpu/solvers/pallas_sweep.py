@@ -112,7 +112,15 @@ def _prep_factors(Js, Cs, interpret=False):
 
     Js, Cs: (D, S, m, m) inverse diagonal factors / sub-diagonal blocks
     (`_block_chol(..., inv=True)` outputs, slab-stacked; D=1 unslabbed).
-    Returns a closure solving (D, S, m, k->) RHS chains for k <= 8."""
+    Returns a closure solving (D, S, m, k->) RHS chains for k <= 8.
+
+    Padding amplification caveat: m pads up to the 128 lane width, so
+    each sweep step streams (mp/m)^2 times the factor bytes — for small
+    blocks (m ~ 10-35, i.e. mp/m ~ 4-13x) the "runs at HBM bandwidth"
+    pitch is dominated by zero padding, not useful factor data. The
+    on-chip A/B (tools/bench_inv_factors.py) is the arbiter; if the
+    padding tax decides it, the fix is packing multiple m-blocks per
+    128-lane tile, not a bigger kernel."""
     D, S, m, _ = Js.shape
     mp = int(np.ceil(m / LANE) * LANE)
     JsP = _pad_to(_pad_to(Js, mp, 2), mp, 3)

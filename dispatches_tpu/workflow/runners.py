@@ -249,20 +249,40 @@ def run_year_sweep(
     # changes the answer (horizon, H2 price, dtype, precision mode) — NOT
     # on (seed, index): re-running with a different scale range / dtype /
     # mixed_precision against the same store must re-solve, not skip
-    skeys = {
-        k: _point_key(
+    # the solver-throughput knobs join the key ONLY when non-default:
+    # they change the iterate path, not the answer (NPV agreement is
+    # tested at rel 1e-3), but a non-default run must not silently skip
+    # scenarios a default run already solved — while stores written
+    # before the knobs existed must still resume a default run
+    knob_key = (
+        (float(correctors), 1.0 if inv_factors else 0.0)
+        if (correctors or inv_factors)
+        else ()
+    )
+
+    def _keys(k):
+        base = (
             "yearsweep",
             float(scales[k]),
             hours,
             h2_price,
             str(jdtype),
             1.0 if (mixed_precision and jdtype == jnp.float64) else 0.0,
-            float(correctors),
-            1.0 if inv_factors else 0.0,
         )
-        for k in range(scenarios)
-    }
-    pending = [k for k in range(scenarios) if skeys[k] not in done]
+        keys = [_point_key(*base, *knob_key)]
+        if not knob_key:
+            # stores written while the knobs were unconditionally keyed
+            # appended default runs under (..., 0.0, 0.0); a default
+            # resume must recognize those too, not re-solve hours of
+            # year-scale scenarios
+            keys.append(_point_key(*base, 0.0, 0.0))
+        return keys
+
+    skeys = {k: _keys(k)[0] for k in range(scenarios)}
+    pending = [
+        k for k in range(scenarios)
+        if not any(key in done for key in _keys(k))
+    ]
     if verbose and len(pending) < scenarios:
         print(f"{scenarios - len(pending)} scenarios checkpointed, skipping")
     for lo in range(0, len(pending), batch):

@@ -1,82 +1,82 @@
-"""Managed-workflow dataset stub — parity with
-`dispatches/workflow/workflow.py:23-101` (`ManagedWorkflow`, `Dataset`,
-`DatasetFactory` with "rts-gmlc" and "null" factories). The reference's
-"rts-gmlc" factory downloads the full RTS-GMLC tree via Prescient; here it
-resolves to the bundled 5-bus RTS-format dataset (zero-egress environment),
-or a caller-supplied directory.
+"""API-parity dataset layer over the bundled RTS-format data.
+
+The reference wraps its Prescient data download in three classes
+(`dispatches/workflow/workflow.py:23-101`: ``ManagedWorkflow`` memoizes
+``Dataset`` objects built by ``DatasetFactory``, whose "rts-gmlc" entry
+downloads the full RTS-GMLC tree). Those three names stay importable —
+user scripts written against the reference keep working — but the
+machinery here is a flat registry of builder functions over the
+zero-egress resolution chain in :func:`rts_gmlc.download` (bundled
+5-bus tree / ``$DISPATCHES_RTS_GMLC_DIR`` / caller path).
 """
 from __future__ import annotations
 
 import os
-
-from . import rts_gmlc
-
-
-class ManagedWorkflow:
-    def __init__(self, name: str, workspace_name: str):
-        self._name = name
-        self._workspace_name = workspace_name
-        self._datasets = {}
-
-    @property
-    def name(self):
-        return self._name
-
-    @property
-    def workspace_name(self):
-        return self._workspace_name
-
-    def get_dataset(self, type_: str, **kwargs):
-        """Create (or return the cached) dataset of the given type."""
-        ds = self._datasets.get(type_, None)
-        if ds is not None:
-            return ds
-        dsf = DatasetFactory(type_, workflow=self)
-        ds = dsf.create(**kwargs)
-        self._datasets[type_] = ds
-        return ds
+from typing import Any, Callable, Dict, Optional
 
 
 class Dataset:
+    """A named bag of metadata describing one resolved data source."""
+
     def __init__(self, name: str):
         self.name = name
-        self._meta = {}
+        self._meta: Dict[str, Any] = {}
 
     @property
-    def meta(self):
-        return self._meta.copy()
+    def meta(self) -> Dict[str, Any]:
+        return dict(self._meta)  # a view the caller can't mutate through
 
-    def add_meta(self, key, value):
+    def add_meta(self, key: str, value: Any) -> None:
         self._meta[key] = value
 
-    def __str__(self):
-        lines = ["Metadata", "--------"]
-        for key, value in self._meta.items():
-            lines.append(f"{key}:")
-            lines.append(str(value))
-        return "\n".join(lines)
+    def __str__(self) -> str:
+        body = "".join(f"{k}:\n{v}\n" for k, v in self._meta.items())
+        return f"Metadata\n--------\n{body}".rstrip("\n")
+
+
+def _build_rts_gmlc(**kwargs: Any) -> Dataset:
+    """Resolve the RTS-format directory and describe its contents."""
+    from . import rts_gmlc
+
+    path = rts_gmlc.download(**kwargs)
+    ds = Dataset("rts-gmlc")
+    ds.add_meta("directory", path)
+    ds.add_meta("files", sorted(os.listdir(path)))
+    return ds
+
+
+#: type name -> builder; "null" deliberately builds nothing (the
+#: reference's no-op dataset used by workflows that bring their own data)
+_BUILDERS: Dict[str, Callable[..., Optional[Dataset]]] = {
+    "rts-gmlc": _build_rts_gmlc,
+    "null": lambda **kwargs: None,
+}
 
 
 class DatasetFactory:
-    def __init__(self, type_: str, workflow=None):
-        self._wf = workflow
-        try:
-            self.create = self._get_factory_function(type_)
-        except KeyError:
+    """Reference-parity shim: ``DatasetFactory(t).create(**kw)`` invokes
+    the registered builder for ``t``; unknown types raise ``KeyError`` at
+    construction (not at ``create`` time), matching the reference."""
+
+    def __init__(self, type_: str, workflow: "ManagedWorkflow | None" = None):
+        builder = _BUILDERS.get(type_)
+        if builder is None:
             raise KeyError(f"Cannot create dataset of type '{type_}'")
+        self.create = builder
+        self._wf = workflow
 
-    @classmethod
-    def _get_factory_function(cls, name: str):
-        if name == "rts-gmlc":
 
-            def download_fn(**kwargs):
-                rts_dir = rts_gmlc.download(**kwargs)
-                dataset = Dataset(name)
-                dataset.add_meta("directory", rts_dir)
-                dataset.add_meta("files", sorted(os.listdir(rts_dir)))
-                return dataset
+class ManagedWorkflow:
+    """A named workspace handing out datasets by type name, memoized so
+    repeated ``get_dataset`` calls share one resolved instance."""
 
-            return download_fn
-        if name == "null":
-            return lambda **kwargs: None
-        raise KeyError(name)
+    def __init__(self, name: str, workspace_name: str):
+        self.name = name
+        self.workspace_name = workspace_name
+        self._cache: Dict[str, Optional[Dataset]] = {}
+
+    def get_dataset(self, type_: str, **kwargs: Any) -> Optional[Dataset]:
+        if self._cache.get(type_) is None:
+            factory = DatasetFactory(type_, workflow=self)
+            self._cache[type_] = factory.create(**kwargs)
+        return self._cache[type_]
