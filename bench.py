@@ -352,6 +352,7 @@ def _year_batch_child(npz_path, By):
         "seconds": round(dt, 3),
         "objs": [float(v) for v in objs],
         "converged": [bool(v) for v in np.asarray(sol2.converged)],
+        "iterations": [int(v) for v in np.asarray(sol2.iterations)],
         "scales_used": [float(v) for v in scales2],
     }
     # atomic: the parent treats this file's existence as proof of a
@@ -686,12 +687,18 @@ def main():
         return (
             yobj, bool(np.asarray(ysol.converged)),
             time.perf_counter() - t0, float(jfac),
+            int(np.asarray(ysol.iterations)),
         )
 
-    yobj, yconv, ydt, yjfac = _device("year timed solve", _year_timed)
+    yobj, yconv, ydt, yjfac, yiters = _device("year timed solve", _year_timed)
+    # iterations recorded so run-to-run drift is diagnosable (same recipe
+    # at different iteration counts explains a time delta; r2->r4 weekly
+    # drifted 17% with no such breadcrumb) and the MFU model
+    # (tools/bench_host_baseline.py) divides by measured iters, not a guess
     _LOCAL["rows"]["year_single"] = {
         "seconds": round(ydt, 3),
         "converged": yconv,
+        "iterations": yiters,
     }
     _flush_local()
     # HiGHS year objective for the SAME (jittered) inputs: the accuracy

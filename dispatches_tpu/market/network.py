@@ -100,8 +100,99 @@ def _read_timeseries(path) -> Tuple[List[str], np.ndarray]:
     return cols, mat
 
 
+def _resolve_timeseries_files(data_dir: Path) -> dict:
+    """Map (simulation, quantity) -> timeseries file via the real
+    RTS-GMLC `timeseries_pointers.csv` schema (the actual tree keeps its
+    series under `timeseries_data_files/` with per-source names — the
+    conventional DAY_AHEAD_load.csv naming only holds for flattened
+    test fixtures like the reference's `tests/data/prescient_5bus`).
+
+    Rows are (Simulation, Category, Object, Parameter, Data File); load
+    series are Category=Area rows, renewable series Category=Generator.
+    Falls back to the conventional names when no pointer file exists."""
+    out = {
+        ("DAY_AHEAD", "load"): [data_dir / "DAY_AHEAD_load.csv"],
+        ("REAL_TIME", "load"): [data_dir / "REAL_TIME_load.csv"],
+        ("DAY_AHEAD", "renewables"): [data_dir / "DAY_AHEAD_renewables.csv"],
+        ("REAL_TIME", "renewables"): [data_dir / "REAL_TIME_renewables.csv"],
+    }
+    ppath = data_dir / "timeseries_pointers.csv"
+    if not ppath.exists():
+        return out
+    found: dict = {}
+    for r in _read_csv(ppath):
+        sim = r["Simulation"].strip()
+        kind = (
+            "load" if r["Category"].strip() == "Area"
+            else "renewables" if r["Category"].strip() == "Generator"
+            else None
+        )
+        if kind is None or sim not in ("DAY_AHEAD", "REAL_TIME"):
+            continue  # Reserve and other categories: not consumed here
+        # paths in the real tree are relative to the pointer file's dir;
+        # a LIST per key because the real tree splits generator series
+        # across per-source files (wind/PV/hydro each point elsewhere)
+        p = (data_dir / r["Data File"].strip()).resolve()
+        found.setdefault((sim, kind), [])
+        if p not in found[(sim, kind)]:
+            found[(sim, kind)].append(p)
+    out.update(found)
+    return out
+
+
+def _read_timeseries_multi(paths) -> Tuple[List[str], np.ndarray]:
+    """Column-join the (possibly several) files a pointer key resolved
+    to; duplicate column names keep the first occurrence (a generator's
+    PMin and PMax rows may point at the same file). The join is
+    positional, so files of different lengths would silently time-shift
+    columns — refuse them instead."""
+    cols: List[str] = []
+    mats: List[np.ndarray] = []
+    lengths = {}
+    for p in paths:
+        c, m = _read_timeseries(p)
+        lengths[str(p)] = m.shape[0]
+        keep = [i for i, name in enumerate(c) if name not in cols]
+        cols.extend(c[i] for i in keep)
+        mats.append(m[:, keep])
+    if len(set(lengths.values())) > 1:
+        raise ValueError(
+            "timeseries files joined by timeseries_pointers.csv disagree "
+            f"on row count (positional join would time-shift): {lengths}"
+        )
+    return cols, np.concatenate(mats, axis=1)
+
+
+def _periods_per_hour(data_dir: Path) -> Tuple[int, int]:
+    """(DA, RT) periods per hour from `simulation_objects.csv`'s
+    Period_Resolution row (seconds per period — the real RTS-GMLC runs
+    REAL_TIME at 300 s, i.e. 12 rows per hour). Defaults to hourly when
+    the file is absent (flattened fixtures)."""
+    spath = data_dir / "simulation_objects.csv"
+    da_s, rt_s = 3600, 3600
+    if spath.exists():
+        for r in _read_csv(spath):
+            key = (r.get("Simulation_Parameters") or "").strip()
+            if key == "Period_Resolution":
+                da_s = int(float(r["DAY_AHEAD"]))
+                rt_s = int(float(r["REAL_TIME"]))
+    return max(3600 // da_s, 1), max(3600 // rt_s, 1)
+
+
+def _to_hourly(mat: np.ndarray, per_hour: int) -> np.ndarray:
+    """Average sub-hourly periods into hours (the SCED host runs hourly;
+    mean power over the hour preserves energy)."""
+    if per_hour <= 1:
+        return mat
+    n = (mat.shape[0] // per_hour) * per_hour
+    return mat[:n].reshape(-1, per_hour, mat.shape[1]).mean(axis=1)
+
+
 def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
-    """Parse an RTS-GMLC-format directory (the reference 5-bus schema)."""
+    """Parse an RTS-GMLC-format directory: the bundled/flattened 5-bus
+    fixture schema, or the real tree layout (`timeseries_pointers.csv`
+    indirection + sub-hourly REAL_TIME resolution from
+    `simulation_objects.csv`, averaged to the hourly SCED grid)."""
     data_dir = Path(data_dir)
     buses = [int(r["Bus ID"]) for r in _read_csv(data_dir / "bus.csv")]
     bidx = {b: i for i, b in enumerate(buses)}
@@ -154,14 +245,56 @@ def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
             )
         )
 
-    load_cols, da_load = _read_timeseries(data_dir / "DAY_AHEAD_load.csv")
-    _, rt_load = _read_timeseries(data_dir / "REAL_TIME_load.csv")
-    ren_cols, da_ren = _read_timeseries(data_dir / "DAY_AHEAD_renewables.csv")
-    _, rt_ren = _read_timeseries(data_dir / "REAL_TIME_renewables.csv")
-    # order renewable columns to match the gen-table order
-    order = [ren_cols.index(u.name) for u in renewable]
-    da_ren = da_ren[:, order]
-    rt_ren = rt_ren[:, order]
+    ts_files = _resolve_timeseries_files(data_dir)
+    da_ph, rt_ph = _periods_per_hour(data_dir)
+    load_cols, da_load = _read_timeseries_multi(
+        ts_files[("DAY_AHEAD", "load")]
+    )
+    rt_load_cols, rt_load = _read_timeseries_multi(
+        ts_files[("REAL_TIME", "load")]
+    )
+    ren_cols, da_ren = _read_timeseries_multi(
+        ts_files[("DAY_AHEAD", "renewables")]
+    )
+    rt_ren_cols, rt_ren = _read_timeseries_multi(
+        ts_files[("REAL_TIME", "renewables")]
+    )
+    da_load, da_ren = _to_hourly(da_load, da_ph), _to_hourly(da_ren, da_ph)
+    rt_load, rt_ren = _to_hourly(rt_load, rt_ph), _to_hourly(rt_ren, rt_ph)
+    # column order: DA and RT come from INDEPENDENT files under pointer
+    # indirection, so each matrix must be reordered by its OWN header —
+    # applying DA's order to RT would silently swap units' series
+    ren_order = [ren_cols.index(u.name) for u in renewable]
+    da_ren = da_ren[:, ren_order]
+    rt_ren = rt_ren[:, [rt_ren_cols.index(u.name) for u in renewable]]
+    rt_load = rt_load[:, [rt_load_cols.index(c) for c in load_cols]]
+
+    # load columns: per-bus IDs in the flattened fixtures, per-AREA IDs
+    # in the real RTS-GMLC tree (DAY_AHEAD_regional_Load.csv columns are
+    # areas 1..3) — disaggregate area load to that area's buses by the
+    # bus.csv 'MW Load' participation factors
+    bus_rows = _read_csv(data_dir / "bus.csv")
+    if not all(
+        c.strip().lstrip("-").isdigit() and int(c) in bidx
+        for c in load_cols
+    ):
+        W = np.zeros((len(load_cols), len(buses)))
+        for j, c in enumerate(load_cols):
+            area = c.strip()
+            members = [
+                r for r in bus_rows
+                if str(r.get("Area", "")).strip() == area
+            ]
+            weights = np.array(
+                [float(r.get("MW Load", 0) or 0) for r in members]
+            )
+            if weights.sum() <= 0:  # unloaded area: spread evenly
+                weights = np.ones(len(members))
+            for r, w in zip(members, weights / weights.sum()):
+                W[j, bidx[int(r["Bus ID"])]] = w
+        da_load = da_load @ W
+        rt_load = rt_load @ W
+        load_cols = [str(b) for b in buses]
 
     reserve = 0.0
     rpath = data_dir / "reserves.csv"

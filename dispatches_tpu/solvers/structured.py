@@ -25,6 +25,7 @@ Pipeline:
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from functools import partial
 from typing import Dict, NamedTuple
 
@@ -943,6 +944,33 @@ def _solve_banded_jit(
     )
 
 
+class SmallTF32Warning(UserWarning):
+    """Pure-f32 banded solve requested in the regime where it measurably
+    under-converges and has no flop advantage (T <= ~200; docs/solvers.md).
+    A distinct category so deliberate small-T f32 users (backend-comparison
+    tests, callers who accept the documented f32 floor) can filter exactly
+    this warning without muting anything else."""
+
+
+def _warn_small_T_f32(meta: TimeStructure, blp: BandedLP) -> None:
+    """Measured boundary (docs/solvers.md): the pure-f32 banded path
+    under-converges on design-bordered weekly-scale LPs (rel ~1e-1 at
+    T~168) where dense `solve_lp` holds 1e-3 — and at small T there is
+    no flop advantage for the banded factorization to recover. Turn that
+    tribal knowledge into behavior: warn at trace time so the caller is
+    steered to the right tool instead of silently getting a bad vertex."""
+    if meta.T <= 200 and jnp.dtype(blp.Ad.dtype) == jnp.float32:
+        warnings.warn(
+            f"solve_lp_banded: pure-f32 banded solve at T={meta.T} <= 200 "
+            "under-converges on design-bordered problems (rel ~1e-1 at "
+            "weekly scale) and has no flop advantage there; use the dense "
+            "solve_lp, or float64 data (optionally chol_dtype=float32 "
+            "mixed precision) for the banded path. See docs/solvers.md.",
+            SmallTF32Warning,
+            stacklevel=3,
+        )
+
+
 def solve_lp_banded(
     meta: TimeStructure,
     blp: BandedLP,
@@ -1012,6 +1040,7 @@ def solve_lp_banded(
     combinable with ``mesh`` (multi-chip keeps the XLA sweeps). On
     non-TPU backends the same kernel runs under the Pallas interpreter
     (tests), so results are backend-independent."""
+    _warn_small_T_f32(meta, blp)
     dtype = blp.Ad.dtype
     if chol_dtype is not None:
         chol_dtype = jnp.dtype(chol_dtype)
@@ -1110,6 +1139,7 @@ def solve_lp_banded_batch(
 
     Do not combine with `mesh=`/`slabs=` sharding of the time axis in one
     call — batch over scenarios OR shard slabs over time, per mesh axis."""
+    _warn_small_T_f32(meta, blp)
     base_ndim = {
         "Ad": 3, "As": 3, "Bb": 3, "b": 2, "c": 2, "cb": 1,
         "l": 2, "u": 2, "lb": 1, "ub": 1, "c0": 0,

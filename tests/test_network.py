@@ -31,6 +31,151 @@ class TestLoader:
         assert np.all(np.diff(steam.seg_cost) > 0)  # convex stack
         assert steam.seg_mw.sum() + steam.p_min == pytest.approx(steam.p_max)
 
+    def test_real_tree_schema(self, tmp_path):
+        """The REAL RTS-GMLC tree layout (vs the flattened fixture):
+        timeseries under a subdirectory with arbitrary names, resolved
+        through `timeseries_pointers.csv`, and sub-hourly REAL_TIME
+        resolution declared in `simulation_objects.csv` — the loader must
+        follow the pointers and average RT periods to the hourly grid
+        (ref: `dispatches/tests/data/prescient_5bus/timeseries_pointers.csv`,
+        `simulation_objects.csv` Period_Resolution 3600/300)."""
+        import csv
+        import shutil
+
+        from dispatches_tpu.market.network import FIVE_BUS_DIR
+
+        src = FIVE_BUS_DIR
+        for f in ("branch.csv", "gen.csv", "reserves.csv",
+                  "initial_status.csv"):
+            shutil.copy(src / f, tmp_path / f)
+        # bus.csv with Area 7 (a NON-bus-ID, like the real tree where
+        # buses are 101.. and areas 1-3): load columns naming an area
+        # must not be mistaken for per-bus columns
+        with open(src / "bus.csv") as f:
+            rows = list(csv.reader(f))
+        ai = rows[0].index("Area")
+        with open(tmp_path / "bus.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(rows[0])
+            for r in rows[1:]:
+                r[ai] = "7"
+                w.writerow(r)
+        ts = tmp_path / "timeseries_data_files"
+        ts.mkdir()
+
+        def area_load(name, out_name):
+            # real-tree load schema: one column per AREA (area "7" =
+            # the whole system), to be disaggregated by bus.csv MW Load
+            with open(src / name) as f:
+                rows = list(csv.reader(f))
+            with open(ts / out_name, "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(rows[0][:4] + ["7"])
+                for r in rows[1:]:
+                    w.writerow(r[:4] + [sum(float(v) for v in r[4:])])
+
+        # DA stays hourly under non-conventional names; renewables SPLIT
+        # across per-source files (the real tree points wind and PV at
+        # different files) to exercise the column join
+        area_load("DAY_AHEAD_load.csv", "da_load_area.csv")
+        with open(src / "DAY_AHEAD_renewables.csv") as f:
+            rows = list(csv.reader(f))
+        hdr = rows[0]
+        for unit, out_name in (("4_WIND", "da_wind.csv"),
+                               ("10_PV", "da_pv.csv")):
+            j = hdr.index(unit)
+            with open(ts / out_name, "w", newline="") as f:
+                w = csv.writer(f)
+                for r in rows:
+                    w.writerow(r[:4] + [r[j]])
+
+        def expand_rt(path, out_name, per_hour=2, reverse_cols=False):
+            # duplicate each hourly row into `per_hour` sub-periods with a
+            # +/-delta that averages back to the hourly value;
+            # reverse_cols flips the series column order (DA and RT files
+            # are independent under pointer indirection — the loader must
+            # reorder each by its OWN header, not apply DA's order to RT)
+            with open(path) as f:
+                rows = list(csv.reader(f))
+            hdr, body = rows[0], rows[1:]
+            sel = list(range(4, len(hdr)))
+            if reverse_cols:
+                sel = sel[::-1]
+            with open(ts / out_name, "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(hdr[:4] + [hdr[i] for i in sel])
+                for r in body:
+                    vals = [float(r[i]) for i in sel]
+                    base = int(r[3])
+                    for j in range(per_hour):
+                        delta = 0.5 if j == 0 else -0.5
+                        w.writerow(
+                            r[:3]
+                            + [(base - 1) * per_hour + j + 1]
+                            + [v + delta for v in vals]
+                        )
+
+        area_load("REAL_TIME_load.csv", "rt_load_hourly.csv")
+        expand_rt(ts / "rt_load_hourly.csv", "rt_load_area.csv")
+        (ts / "rt_load_hourly.csv").unlink()
+        expand_rt(
+            src / "REAL_TIME_renewables.csv", "rt_gen_series.csv",
+            reverse_cols=True,
+        )
+        with open(tmp_path / "timeseries_pointers.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(
+                ["Simulation", "Category", "Object", "Parameter",
+                 "Data File"]
+            )
+            d = "timeseries_data_files"
+            w.writerow(["DAY_AHEAD", "Area", "1", "MW Load",
+                        f"{d}/da_load_area.csv"])
+            w.writerow(["REAL_TIME", "Area", "1", "MW Load",
+                        f"{d}/rt_load_area.csv"])
+            w.writerow(["DAY_AHEAD", "Generator", "4_WIND", "PMax MW",
+                        f"{d}/da_wind.csv"])
+            # PMin row pointing at the same file: must not duplicate cols
+            w.writerow(["DAY_AHEAD", "Generator", "4_WIND", "PMin MW",
+                        f"{d}/da_wind.csv"])
+            w.writerow(["DAY_AHEAD", "Generator", "10_PV", "PMax MW",
+                        f"{d}/da_pv.csv"])
+            w.writerow(["REAL_TIME", "Generator", "4_WIND", "PMax MW",
+                        f"{d}/rt_gen_series.csv"])
+            w.writerow(["DAY_AHEAD", "Reserve", "Spin_Up_R1", "Requirement",
+                        f"{d}/missing_ok.csv"])  # unconsumed category
+        with open(tmp_path / "simulation_objects.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(
+                ["Simulation_Parameters", "Description", "DAY_AHEAD",
+                 "REAL_TIME"]
+            )
+            w.writerow(["Periods_per_Step", "", "24", "1"])
+            w.writerow(["Period_Resolution", "", "3600", "1800"])
+
+        grid = load_rts_format(tmp_path)
+        # area-format load disaggregates over ALL buses by the bus.csv
+        # MW Load weights (bus 1 carries none); the fixture's DA series
+        # is weight-proportional up to its 3-decimal CSV rounding, so
+        # per-bus values round-trip to ~1e-3
+        assert grid.load_bus == [1, 2, 3, 4, 10]
+        np.testing.assert_allclose(grid.da_load[:, 0], 0.0)
+        np.testing.assert_allclose(
+            grid.da_load[:, 1:], GRID.da_load, atol=3e-3
+        )
+        # RT is not weight-proportional row by row: the area path
+        # preserves hourly TOTALS and the weight split
+        np.testing.assert_allclose(
+            grid.rt_load.sum(axis=1), GRID.rt_load.sum(axis=1), atol=1e-6
+        )
+        # RT renewables were written column-REVERSED: correct loading
+        # proves each matrix is reordered by its own header
+        np.testing.assert_allclose(
+            grid.rt_renewables, GRID.rt_renewables, atol=1e-9
+        )
+        # the split-file DA renewables joined back in gen-table order
+        np.testing.assert_allclose(grid.da_renewables, GRID.da_renewables)
+
 
 class TestDCOPF:
     def test_uncongested_lmp_is_marginal_cost(self):

@@ -9,6 +9,12 @@ import numpy as np
 import pytest
 import jax.numpy as jnp
 
+# backend-comparison tests here deliberately run pure-f32 at small T and
+# assert against the known f32 floor; the steering warning is not for them
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::dispatches_tpu.solvers.structured.SmallTF32Warning"
+)
+
 from dispatches_tpu.case_studies.renewables import params as P
 from dispatches_tpu.case_studies.renewables.pricetaker import (
     HybridDesign,

@@ -195,6 +195,36 @@ def test_f32_long_horizon_converges():
     assert float(mixed.obj) == pytest.approx(float(ref.obj), rel=2e-3)
 
 
+class TestSmallTF32Guard:
+    """The pure-f32 banded path under-converges at weekly scale (docs/
+    solvers.md, rel ~1e-1 at T~168 vs dense solve_lp's 1e-3); the solver
+    must SAY so instead of leaving it as documentation-only knowledge."""
+
+    def test_warns_on_small_T_pure_f32(self):
+        T = 48
+        prog, p = _flagship(T)
+        meta = extract_time_structure(prog, T, block_hours=12)
+        p32 = {k: v.astype(jnp.float32) for k, v in p.items()}
+        blp32 = meta.instantiate(p32, dtype=jnp.float32)
+        with pytest.warns(UserWarning, match="no flop advantage"):
+            solve_lp_banded(meta, blp32, tol=1e-3, max_iter=2)
+
+    def test_silent_for_f64_small_T(self):
+        import warnings as _w
+
+        from dispatches_tpu.solvers.structured import SmallTF32Warning
+
+        T = 48
+        prog, p = _flagship(T)
+        meta = extract_time_structure(prog, T, block_hours=12)
+        blp = meta.instantiate(p)  # f64 under the conftest x64 default
+        with _w.catch_warnings():
+            # error ONLY on the guard's own category: an unrelated JAX
+            # deprecation warning must not fail this contract test
+            _w.simplefilter("error", SmallTF32Warning)
+            solve_lp_banded(meta, blp, tol=1e-3, max_iter=2)
+
+
 class TestMixedPrecision:
     """f32-factor + full-dtype iterative refinement (the f32-speed /
     f64-accuracy year path, `_banded_ops(chol_dtype=..., kkt_refine=...)`).
