@@ -109,7 +109,14 @@ def _resolve_timeseries_files(data_dir: Path) -> dict:
 
     Rows are (Simulation, Category, Object, Parameter, Data File); load
     series are Category=Area rows, renewable series Category=Generator.
-    Falls back to the conventional names when no pointer file exists."""
+    Falls back to the conventional names when no pointer file exists.
+
+    Returns (files, pointer_kinds): `pointer_kinds` is the set of
+    (simulation, quantity) keys that were resolved THROUGH pointer rows —
+    load columns in pointer-resolved files are AREA Objects (the real
+    tree and the reference's own prescient_5bus fixture both use area
+    IDs that can collide with bus IDs, so semantics must come from the
+    Category, not from column spelling)."""
     out = {
         ("DAY_AHEAD", "load"): [data_dir / "DAY_AHEAD_load.csv"],
         ("REAL_TIME", "load"): [data_dir / "REAL_TIME_load.csv"],
@@ -118,7 +125,7 @@ def _resolve_timeseries_files(data_dir: Path) -> dict:
     }
     ppath = data_dir / "timeseries_pointers.csv"
     if not ppath.exists():
-        return out
+        return out, set()
     found: dict = {}
     for r in _read_csv(ppath):
         sim = r["Simulation"].strip()
@@ -137,7 +144,7 @@ def _resolve_timeseries_files(data_dir: Path) -> dict:
         if p not in found[(sim, kind)]:
             found[(sim, kind)].append(p)
     out.update(found)
-    return out
+    return out, set(found)
 
 
 def _read_timeseries_multi(paths) -> Tuple[List[str], np.ndarray]:
@@ -245,7 +252,7 @@ def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
             )
         )
 
-    ts_files = _resolve_timeseries_files(data_dir)
+    ts_files, pointer_kinds = _resolve_timeseries_files(data_dir)
     da_ph, rt_ph = _periods_per_hour(data_dir)
     load_cols, da_load = _read_timeseries_multi(
         ts_files[("DAY_AHEAD", "load")]
@@ -269,15 +276,15 @@ def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
     rt_ren = rt_ren[:, [rt_ren_cols.index(u.name) for u in renewable]]
     rt_load = rt_load[:, [rt_load_cols.index(c) for c in load_cols]]
 
-    # load columns: per-bus IDs in the flattened fixtures, per-AREA IDs
-    # in the real RTS-GMLC tree (DAY_AHEAD_regional_Load.csv columns are
-    # areas 1..3) — disaggregate area load to that area's buses by the
-    # bus.csv 'MW Load' participation factors
-    bus_rows = _read_csv(data_dir / "bus.csv")
-    if not all(
-        c.strip().lstrip("-").isdigit() and int(c) in bidx
-        for c in load_cols
-    ):
+    # load columns: per-bus IDs in the flattened fixtures (no pointer
+    # file), per-AREA Objects when the series came through a Category=
+    # Area pointer row — which is how both the real RTS-GMLC tree and
+    # the reference's prescient_5bus fixture ship them, with area IDs
+    # that COLLIDE with bus IDs, so the Category decides, never the
+    # column spelling. Area load disaggregates to that area's buses by
+    # the bus.csv 'MW Load' participation factors.
+    if ("DAY_AHEAD", "load") in pointer_kinds:
+        bus_rows = _read_csv(data_dir / "bus.csv")
         W = np.zeros((len(load_cols), len(buses)))
         for j, c in enumerate(load_cols):
             area = c.strip()
@@ -285,6 +292,12 @@ def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
                 r for r in bus_rows
                 if str(r.get("Area", "")).strip() == area
             ]
+            if not members:
+                raise ValueError(
+                    f"load series column '{area}' names an area with no "
+                    "member buses in bus.csv — its load would be "
+                    "silently dropped"
+                )
             weights = np.array(
                 [float(r.get("MW Load", 0) or 0) for r in members]
             )

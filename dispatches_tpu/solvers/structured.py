@@ -1139,7 +1139,8 @@ def solve_lp_banded_batch(
 
     Do not combine with `mesh=`/`slabs=` sharding of the time axis in one
     call — batch over scenarios OR shard slabs over time, per mesh axis."""
-    _warn_small_T_f32(meta, blp)
+    # (no _warn_small_T_f32 here: every path below delegates to
+    # solve_lp_banded, whose own guard fires once per trace)
     base_ndim = {
         "Ad": 3, "As": 3, "Bb": 3, "b": 2, "c": 2, "cb": 1,
         "l": 2, "u": 2, "lb": 1, "ub": 1, "c0": 0,

@@ -45,32 +45,23 @@ class TestLoader:
         from dispatches_tpu.market.network import FIVE_BUS_DIR
 
         src = FIVE_BUS_DIR
-        for f in ("branch.csv", "gen.csv", "reserves.csv",
+        for f in ("bus.csv", "branch.csv", "gen.csv", "reserves.csv",
                   "initial_status.csv"):
             shutil.copy(src / f, tmp_path / f)
-        # bus.csv with Area 7 (a NON-bus-ID, like the real tree where
-        # buses are 101.. and areas 1-3): load columns naming an area
-        # must not be mistaken for per-bus columns
-        with open(src / "bus.csv") as f:
-            rows = list(csv.reader(f))
-        ai = rows[0].index("Area")
-        with open(tmp_path / "bus.csv", "w", newline="") as f:
-            w = csv.writer(f)
-            w.writerow(rows[0])
-            for r in rows[1:]:
-                r[ai] = "7"
-                w.writerow(r)
         ts = tmp_path / "timeseries_data_files"
         ts.mkdir()
 
         def area_load(name, out_name):
-            # real-tree load schema: one column per AREA (area "7" =
-            # the whole system), to be disaggregated by bus.csv MW Load
+            # real-tree load schema: one column per AREA. Deliberately
+            # area "1", which COLLIDES with bus ID 1 (exactly like the
+            # reference's prescient_5bus fixture, whose area columns
+            # "1"/"2" are also bus IDs): the loader must use the
+            # Category=Area pointer signal, not the column spelling
             with open(src / name) as f:
                 rows = list(csv.reader(f))
             with open(ts / out_name, "w", newline="") as f:
                 w = csv.writer(f)
-                w.writerow(rows[0][:4] + ["7"])
+                w.writerow(rows[0][:4] + ["1"])
                 for r in rows[1:]:
                     w.writerow(r[:4] + [sum(float(v) for v in r[4:])])
 
