@@ -283,7 +283,18 @@ def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
     # that COLLIDE with bus IDs, so the Category decides, never the
     # column spelling. Area load disaggregates to that area's buses by
     # the bus.csv 'MW Load' participation factors.
-    if ("DAY_AHEAD", "load") in pointer_kinds:
+    da_area = ("DAY_AHEAD", "load") in pointer_kinds
+    rt_area = ("REAL_TIME", "load") in pointer_kinds
+    if da_area != rt_area:
+        # the disaggregation below is applied to BOTH matrices; a tree
+        # where only one of DA/RT resolves through Area pointer rows
+        # would silently mix area totals with per-bus series
+        raise ValueError(
+            "timeseries_pointers.csv resolves load for only one of "
+            "DAY_AHEAD/REAL_TIME — both must use the same (area vs "
+            "per-bus) schema"
+        )
+    if da_area:
         bus_rows = _read_csv(data_dir / "bus.csv")
         W = np.zeros((len(load_cols), len(buses)))
         for j, c in enumerate(load_cols):

@@ -1,0 +1,36 @@
+"""Shared hang-mode watchdog for the chip tools.
+
+The tunnel's hang mode blocks device calls forever at 0% CPU
+(memory/BENCH_NOTES: one of the four observed failure modes), so every
+device-touching thunk in tools/ runs through this: a daemon worker
+thread plus a timeout on the result queue. The stuck thread cannot be
+killed, but the process can raise, move on, and exit — same pattern as
+bench.py's `_device`, minus its retry/diagnostics machinery which the
+one-shot tools don't want.
+
+IMPORTANT for callers: jax dispatch is asynchronous — the thunk must
+MATERIALIZE its result (np.asarray / float()) inside the thunk, or the
+watchdog returns before the device work happens and the unguarded
+synchronization hangs later.
+"""
+import queue
+import threading
+
+
+def with_watchdog(fn, timeout_s=600.0):
+    q = queue.Queue()
+
+    def worker():
+        try:
+            q.put(("ok", fn()))
+        except Exception as exc:
+            q.put(("err", exc))
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        kind, val = q.get(timeout=timeout_s)
+    except queue.Empty:
+        raise TimeoutError(f"device call hung > {timeout_s:.0f}s")
+    if kind == "err":
+        raise val
+    return val

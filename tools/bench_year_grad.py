@@ -1,0 +1,159 @@
+"""On-chip timing of the year solve WITH design gradients.
+
+BASELINE.md's north-star reads "8,760 h x 500 scenarios ... WITH
+gradients w.r.t. design sizing variables" — the bench rows time the
+solves, but nothing on-chip has ever timed the differentiable path.
+This tool runs `jax.value_and_grad(optimal_value_banded)` on the full
+8,760-h design LP with the chip-proven recipe (bench.py YEAR_KW) and
+records solve-only vs solve+grad wall time — the gradient is an
+envelope-theorem Lagrangian evaluation (no adjoint KKT solve,
+`solvers/structured.py::optimal_value_banded`), so the expected
+overhead is small; measuring it closes the "with gradients" clause.
+
+Gates: value within 5e-2 of host HiGHS on the same inputs (the pure-f32
+year contract), gradient finite. Writes YEAR_GRAD.json. Run on the
+real chip (watch-loop stage); hang-mode watchdog on every device call.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "YEAR_GRAD.json")
+
+
+from _watchdog import with_watchdog  # noqa: E402  (tools/ is sys.path[0])
+
+
+def main():
+    global OUT
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # CPU plumbing check: in-process override (env var JAX_PLATFORMS
+        # does not beat the ambient sitecustomize)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    from bench import YEAR_BLOCK_HOURS, YEAR_KW
+    from dispatches_tpu.case_studies.renewables import params as P
+    from dispatches_tpu.case_studies.renewables.pricetaker import (
+        HybridDesign,
+        build_pricetaker,
+    )
+    from dispatches_tpu.solvers.reference import solve_lp_scipy_sparse
+    from dispatches_tpu.solvers.structured import (
+        extract_time_structure,
+        optimal_value_banded,
+        solve_lp_banded,
+    )
+
+    # YGRAD_HOURS=1168 is the CPU plumbing-check size (Tb=16, slabs ok);
+    # results at reduced hours are smoke, not benchmarks — ANY off-spec
+    # run (forced CPU or reduced hours) writes the smoke file, never the
+    # real chip capture
+    Ty = int(os.environ.get("YGRAD_HOURS", "8760"))
+    if os.environ.get("BENCH_FORCE_CPU") == "1" or Ty != 8760:
+        OUT = os.path.join(REPO, "YEAR_GRAD_SMOKE.json")
+    prog, _ = build_pricetaker(
+        HybridDesign(
+            T=Ty, with_battery=True, with_pem=True, design_opt=True,
+            h2_price_per_kg=2.5, initial_soc_fixed=None,
+        )
+    )
+    data = P.load_rts303()
+    rng = np.random.default_rng(time.time_ns() % (2**32))
+    ylmp = np.resize(data["da_lmp"], Ty) * rng.uniform(0.95, 1.05, Ty)
+    ycf = np.resize(data["da_wind_cf"], Ty)
+    meta = extract_time_structure(prog, Ty, block_hours=YEAR_BLOCK_HOURS)
+    cf32 = jnp.asarray(ycf, jnp.float32)
+
+    def value_only(lm):
+        blp = meta.instantiate(
+            {"lmp": lm, "wind_cf": cf32}, dtype=jnp.float32
+        )
+        # model-sense (prog.obj_sense), matching optimal_value_banded:
+        # the two value fields must be directly comparable
+        return prog.obj_sense * solve_lp_banded(meta, blp, **YEAR_KW).obj
+
+    def value_grad(lm):
+        return jax.value_and_grad(
+            lambda l: optimal_value_banded(
+                meta, {"lmp": l, "wind_cf": cf32}, dtype=jnp.float32,
+                **YEAR_KW,
+            )
+        )(lm)
+
+    print(f"devices: {jax.devices()}", flush=True)
+    rows = {}
+    for label, fn, pull in (
+        ("solve_only", value_only, lambda o: (float(np.asarray(o)), None)),
+        ("solve_plus_grad", value_grad,
+         lambda o: (float(np.asarray(o[0])), np.asarray(o[1]))),
+    ):
+        # `pull` MATERIALIZES (float/np.asarray) — it must run inside the
+        # watchdog thunk, or async dispatch returns instantly and the
+        # unguarded synchronization hangs later (tunnel hang mode)
+        lm0 = jnp.asarray(ylmp, jnp.float32)
+        with_watchdog(
+            lambda fn=fn, pull=pull, lm=lm0: pull(fn(lm)), timeout_s=1800.0
+        )  # warm/compile
+        # timed on jittered inputs (tunnel memoization guard)
+        jf = np.float32(1.0 + rng.uniform(0.5e-6, 5e-6))
+        lm1 = jnp.asarray(ylmp * jf, jnp.float32)
+        t0 = time.perf_counter()
+        val, grad = with_watchdog(
+            lambda fn=fn, pull=pull, lm=lm1: pull(fn(lm)), timeout_s=1200.0
+        )
+        dt = time.perf_counter() - t0
+        rows[label] = {"seconds": round(dt, 3), "value": val,
+                       "jitter": float(jf)}
+        if grad is not None:
+            rows[label]["grad_finite"] = bool(np.isfinite(grad).all())
+            rows[label]["grad_nonzero_frac"] = float(
+                np.mean(np.abs(grad) > 0)
+            )
+        print(f"{label}: {dt:.2f}s value={val:.6g}", flush=True)
+
+    # accuracy gate vs host HiGHS on the solve+grad run's inputs. NOTE:
+    # `optimal_value_banded` reports in the MODEL's sense (a maximized
+    # NPV comes back positive, `diff.py::optimal_value` convention) while
+    # HiGHS reports the lowered min-LP objective — compare through
+    # prog.obj_sense or the gate measures the sign flip, not accuracy.
+    ref = solve_lp_scipy_sparse(
+        prog,
+        {"lmp": jnp.asarray(
+            ylmp * rows["solve_plus_grad"]["jitter"], jnp.float64
+        ),
+         "wind_cf": jnp.asarray(ycf, jnp.float64)},
+    ).obj_with_offset
+    ref_model_sense = float(prog.obj_sense) * ref
+    err = abs(rows["solve_plus_grad"]["value"] - ref_model_sense) / max(
+        1.0, abs(ref_model_sense)
+    )
+    rows["rel_err_vs_highs"] = err
+    rows["grad_overhead_seconds"] = round(
+        rows["solve_plus_grad"]["seconds"] - rows["solve_only"]["seconds"],
+        3,
+    )
+    rows["gate_ok"] = bool(
+        err < 5e-2 and rows["solve_plus_grad"].get("grad_finite")
+    )
+    rows["hours"] = Ty
+    rows["recipe"] = dict(block_hours=YEAR_BLOCK_HOURS, **YEAR_KW)
+    rows["devices"] = [str(d) for d in jax.devices()]
+    rows["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    tmp = OUT + f".{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(rows, f, indent=1)
+    os.replace(tmp, OUT)
+    print(json.dumps(rows))
+
+
+if __name__ == "__main__":
+    main()

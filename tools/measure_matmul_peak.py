@@ -20,31 +20,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "MATMUL_PEAK.json")
 
 
-def _with_watchdog(fn, timeout_s=300.0):
-    """Tunnel hang mode blocks device calls forever at 0% CPU; a hung
-    probe must time out (and fail this tool) instead of wedging the
-    watch-loop slot that runs it. Daemon thread, same pattern as
-    bench.py's _device (the stuck thread can't be killed, but the
-    process can move on and exit)."""
-    import queue
-    import threading
-
-    q = queue.Queue()
-
-    def worker():
-        try:
-            q.put(("ok", fn()))
-        except Exception as exc:
-            q.put(("err", exc))
-
-    threading.Thread(target=worker, daemon=True).start()
-    try:
-        kind, val = q.get(timeout=timeout_s)
-    except queue.Empty:
-        raise TimeoutError(f"device call hung > {timeout_s:.0f}s")
-    if kind == "err":
-        raise val
-    return val
+from _watchdog import with_watchdog  # noqa: E402  (tools/ is sys.path[0])
 
 
 def main():
@@ -62,7 +38,7 @@ def main():
         # transfer-dominated and deflate every MFU that divides by it
         f = jax.jit(lambda x, y: (x @ y).sum())
         # compile + first run (watchdogged: compile is the likeliest hang)
-        _with_watchdog(lambda: float(np.asarray(f(a, b))), timeout_s=600.0)
+        with_watchdog(lambda: float(np.asarray(f(a, b))), timeout_s=600.0)
         # timed: fresh jittered inputs PER REP (identical inputs rep-to-rep
         # could be served from the tunnel's memoization cache), one
         # scalar-fetch sync per rep
@@ -71,7 +47,8 @@ def main():
                for _ in range(reps)]
         t0 = time.perf_counter()
         for a2 in a2s:
-            _with_watchdog(lambda a2=a2: float(np.asarray(f(a2, b))))
+            with_watchdog(lambda a2=a2: float(np.asarray(f(a2, b))),
+                          timeout_s=300.0)
         dt = (time.perf_counter() - t0) / reps
         tflops = 2.0 * n**3 / dt / 1e12
         rows.append({"n": n, "seconds": round(dt, 4),
