@@ -268,6 +268,18 @@ def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
     )
     da_load, da_ren = _to_hourly(da_load, da_ph), _to_hourly(da_ren, da_ph)
     rt_load, rt_ren = _to_hourly(rt_load, rt_ph), _to_hourly(rt_ren, rt_ph)
+    # schema agreement FIRST (before any column reindexing can crash on
+    # the mismatch with an unhelpful message): load must resolve through
+    # Area pointer rows for both DA and RT, or for neither — the area
+    # disaggregation below applies to both matrices
+    da_area = ("DAY_AHEAD", "load") in pointer_kinds
+    rt_area = ("REAL_TIME", "load") in pointer_kinds
+    if da_area != rt_area:
+        raise ValueError(
+            "timeseries_pointers.csv resolves load for only one of "
+            "DAY_AHEAD/REAL_TIME — both must use the same (area vs "
+            "per-bus) schema"
+        )
     # column order: DA and RT come from INDEPENDENT files under pointer
     # indirection, so each matrix must be reordered by its OWN header —
     # applying DA's order to RT would silently swap units' series
@@ -283,17 +295,6 @@ def load_rts_format(data_dir=FIVE_BUS_DIR) -> GridData:
     # that COLLIDE with bus IDs, so the Category decides, never the
     # column spelling. Area load disaggregates to that area's buses by
     # the bus.csv 'MW Load' participation factors.
-    da_area = ("DAY_AHEAD", "load") in pointer_kinds
-    rt_area = ("REAL_TIME", "load") in pointer_kinds
-    if da_area != rt_area:
-        # the disaggregation below is applied to BOTH matrices; a tree
-        # where only one of DA/RT resolves through Area pointer rows
-        # would silently mix area totals with per-bus series
-        raise ValueError(
-            "timeseries_pointers.csv resolves load for only one of "
-            "DAY_AHEAD/REAL_TIME — both must use the same (area vs "
-            "per-bus) schema"
-        )
     if da_area:
         bus_rows = _read_csv(data_dir / "bus.csv")
         W = np.zeros((len(load_cols), len(buses)))

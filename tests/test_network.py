@@ -167,6 +167,70 @@ class TestLoader:
         # the split-file DA renewables joined back in gen-table order
         np.testing.assert_allclose(grid.da_renewables, GRID.da_renewables)
 
+    def test_real_tree_guards(self, tmp_path):
+        """The three refuse-don't-corrupt guards of the pointer-file
+        path: length-mismatched column joins, one-sided area schema, and
+        an area with no member buses all raise instead of silently
+        producing wrong loads."""
+        import csv
+
+        from dispatches_tpu.market.network import (
+            _read_timeseries_multi,
+            _resolve_timeseries_files,
+        )
+
+        def write_ts(path, cols, n, offset=0.0):
+            with open(path, "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(["Year", "Month", "Day", "Period"] + cols)
+                for k in range(n):
+                    w.writerow([2020, 1, 1 + k // 24, k % 24 + 1]
+                               + [offset + k] * len(cols))
+
+        # 1) positional join refuses files of different lengths
+        write_ts(tmp_path / "a.csv", ["u1"], 48)
+        write_ts(tmp_path / "b.csv", ["u2"], 24)
+        with pytest.raises(ValueError, match="row count"):
+            _read_timeseries_multi([tmp_path / "a.csv", tmp_path / "b.csv"])
+
+        # 2) pointer rows resolving load for only one of DA/RT raise
+        # (area totals must not mix with per-bus series)
+        with open(tmp_path / "timeseries_pointers.csv", "w",
+                  newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["Simulation", "Category", "Object", "Parameter",
+                        "Data File"])
+            w.writerow(["DAY_AHEAD", "Area", "1", "MW Load", "a.csv"])
+        files, kinds = _resolve_timeseries_files(tmp_path)
+        assert ("DAY_AHEAD", "load") in kinds
+        assert ("REAL_TIME", "load") not in kinds
+        import shutil
+
+        from dispatches_tpu.market.network import FIVE_BUS_DIR
+
+        for fname in ("bus.csv", "branch.csv", "gen.csv", "reserves.csv"):
+            shutil.copy(FIVE_BUS_DIR / fname, tmp_path / fname)
+        for fname in ("DAY_AHEAD_renewables.csv", "REAL_TIME_renewables.csv",
+                      "REAL_TIME_load.csv"):
+            shutil.copy(FIVE_BUS_DIR / fname, tmp_path / fname)
+        write_ts(tmp_path / "a.csv", ["1"], 48, offset=100.0)
+        with pytest.raises(ValueError, match="only one of"):
+            load_rts_format(tmp_path)
+
+        # 3) an area column with no member buses raises (both DA and RT
+        # point at area "9", which no bus.csv row declares)
+        with open(tmp_path / "timeseries_pointers.csv", "w",
+                  newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["Simulation", "Category", "Object", "Parameter",
+                        "Data File"])
+            w.writerow(["DAY_AHEAD", "Area", "9", "MW Load", "da9.csv"])
+            w.writerow(["REAL_TIME", "Area", "9", "MW Load", "rt9.csv"])
+        write_ts(tmp_path / "da9.csv", ["9"], 48, offset=100.0)
+        write_ts(tmp_path / "rt9.csv", ["9"], 48, offset=100.0)
+        with pytest.raises(ValueError, match="no member buses"):
+            load_rts_format(tmp_path)
+
 
 class TestDCOPF:
     def test_uncongested_lmp_is_marginal_cost(self):
