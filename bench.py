@@ -85,6 +85,33 @@ _LOCAL_PATH = os.path.join(
 _DIAG_PATH = os.path.join(
     REPO, "BENCH_SMOKE_DIAG.json" if _OFF_RECORD else "BENCH_DIAG.json"
 )
+# Run journal (dispatches_tpu.obs): the append-only event record of a bench
+# run — stage spans with wall-clock + retrace deltas, per-attempt failure
+# events, row results. BENCH_DIAG.json keeps its name and shape (the watch
+# loop reads it) but is now a derived artifact: everything in it also lands
+# in the journal, with more structure. Same off-record redirection rule.
+_JOURNAL_PATH = os.path.join(
+    REPO, "BENCH_SMOKE_JOURNAL.jsonl" if _OFF_RECORD else "BENCH_JOURNAL.jsonl"
+)
+_TRACER = None
+
+
+def _journal():
+    """The run journal, created on first use — importing bench for the
+    year-batch child entry point must not emit a parent-run manifest."""
+    global _TRACER
+    if _TRACER is None:
+        from dispatches_tpu.obs import Tracer
+
+        _TRACER = Tracer(
+            _JOURNAL_PATH,
+            manifest_extra={
+                "tool": "bench",
+                "smoke": _SMOKE,
+                "force_cpu": _FORCE_CPU,
+            },
+        )
+    return _TRACER
 
 # stash any prior run's record BEFORE this run's first flush overwrites it:
 # _fail cites these survivors when this run dies before measuring anything
@@ -145,6 +172,12 @@ def _write_diag(stage, fatal_error=None):
     _DIAG["ts"] = _now()
     if fatal_error:
         _DIAG["fatal_error"] = fatal_error
+    _journal().event(
+        "diag",
+        stage=stage,
+        fatal=bool(fatal_error),
+        attempts=len(_DIAG["attempts"]),
+    )
     _atomic_dump(_DIAG, _DIAG_PATH)
 
 
@@ -162,6 +195,9 @@ def _flush_local():
 
 def _fail(stage, n_attempts, fatal_fast=False):
     _write_diag(stage)
+    _journal().event(
+        "bench_failed", stage=stage, attempts=n_attempts, fatal_fast=fatal_fast
+    )
     # a capture-time outage must not hide that the chip DID work earlier:
     # point at the last measured rows (this run's partial flushes, or a
     # prior run's survivors) — value stays 0.0, no stale number is
@@ -268,38 +304,47 @@ def _device(stage, fn, timeout_s=900.0):
             raise val
         return val
 
-    for i, delay in enumerate((0,) + _DELAYS):
-        if delay:
-            time.sleep(delay)
-        t0 = time.perf_counter()
-        try:
-            out = run_with_watchdog()
-            _DIAG["stage_times"][stage] = round(time.perf_counter() - t0, 3)
-            return out
-        except Exception as e:
-            msg = f"{type(e).__name__}: {e}"
-            _DIAG["attempts"].append(
-                {"stage": stage, "attempt": i + 1, "ts": _now(),
-                 "error": msg[:4000]}
-            )
-            # flush diagnostics after EVERY failed attempt (not only at
-            # final failure): a later hard kill must not erase the record
-            _write_diag(stage)
-            print(
-                f"bench: stage '{stage}' attempt {i + 1} failed: "
-                f"{msg[:300]}",
-                file=sys.stderr,
-                flush=True,
-            )
-            if isinstance(e, _StageTimeout):
-                continue  # retryable by definition
-            if any(pat in msg.lower() for pat in _FATAL_FAST):
-                _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
-                _fail(stage, i + 1, fatal_fast=True)
-            if not any(pat in msg.lower() for pat in _RETRYABLE):
-                _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
-                raise
-    _fail(stage, len(_DELAYS) + 1)
+    # stage span: wall-clock (incl. backoff sleeps), retrace delta, and
+    # every failed attempt land in the journal; stage_times/attempts in
+    # BENCH_DIAG.json are the derived legacy view of the same record
+    with _journal().span(stage, timeout_s=timeout_s):
+        for i, delay in enumerate((0,) + _DELAYS):
+            if delay:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            try:
+                out = run_with_watchdog()
+                dt = round(time.perf_counter() - t0, 3)
+                _DIAG["stage_times"][stage] = dt
+                _journal().metric("stage_seconds", dt, attempt=i + 1)
+                return out
+            except Exception as e:
+                msg = f"{type(e).__name__}: {e}"
+                _DIAG["attempts"].append(
+                    {"stage": stage, "attempt": i + 1, "ts": _now(),
+                     "error": msg[:4000]}
+                )
+                _journal().event(
+                    "attempt_failed", attempt=i + 1, error=msg[:2000]
+                )
+                # flush diagnostics after EVERY failed attempt (not only at
+                # final failure): a later hard kill must not erase the record
+                _write_diag(stage)
+                print(
+                    f"bench: stage '{stage}' attempt {i + 1} failed: "
+                    f"{msg[:300]}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                if isinstance(e, _StageTimeout):
+                    continue  # retryable by definition
+                if any(pat in msg.lower() for pat in _FATAL_FAST):
+                    _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
+                    _fail(stage, i + 1, fatal_fast=True)
+                if not any(pat in msg.lower() for pat in _RETRYABLE):
+                    _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
+                    raise
+        _fail(stage, len(_DELAYS) + 1)
 
 
 # ----------------------------------------------------------------------
@@ -616,6 +661,9 @@ def main():
     # Convergence gate: a throughput number for solves that did not converge
     # is not a benchmark (round-1 lesson: 679k "solves/sec" at converged=0).
     if conv_frac < 0.99:
+        _journal().event(
+            "gate_failed", gate="weekly convergence", converged=conv_frac
+        )
         _write_diag("weekly convergence gate")
         print(
             json.dumps(
@@ -659,6 +707,7 @@ def main():
     _LOCAL["rows"]["weekly"]["rel_err_vs_highs"] = rel_err
     _LOCAL["rows"]["weekly"]["cpu_highs_solves_per_sec"] = cpu_solves_per_sec
     _flush_local()
+    _journal().event("row", name="weekly", **_LOCAL["rows"]["weekly"])
 
     # ------------------------------------------------------------------
     # Year rows: the 8,760-h design LP via the block-tridiagonal IPM
@@ -748,12 +797,14 @@ def main():
     _LOCAL["rows"]["year_single"]["rel_err_vs_highs"] = yerr
     _LOCAL["rows"]["year_single"]["gate_ok"] = yok
     _flush_local()
+    _journal().event("row", name="year_single", **_LOCAL["rows"]["year_single"])
 
     # scenario-batched year row (north-star axis): By simultaneous 8,760-h
     # design LPs, shared banded structure, per-scenario LMP draws, one vmap
     # — in an ISOLATED CHILD PROCESS with By fallback (see module docstring)
     By0 = int(os.environ.get("BENCH_YEAR_BATCH", "2" if smoke else "4"))
-    yb = _run_year_batch_via_child(ylmp, ycf, By0)
+    with _journal().span("year batch (child)", By0=By0):
+        yb = _run_year_batch_via_child(ylmp, ycf, By0)
     _LOCAL["rows"]["year_batch"] = yb
     _flush_local()
 
@@ -798,6 +849,7 @@ def main():
             "year-batch row FAILED in child process (worker crash/timeout; "
             "see BENCH_LOCAL.json fallback_errors)"
         )
+    _journal().event("row", name="year_batch", **_LOCAL["rows"]["year_batch"])
 
     result = {
         "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
@@ -826,6 +878,7 @@ def main():
     _LOCAL["partial"] = False
     _LOCAL["result"] = result
     _flush_local()
+    _journal().event("result", **result)
 
     print(json.dumps(result))
 
@@ -834,4 +887,10 @@ if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--year-batch-child":
         _year_batch_child(sys.argv[2], int(sys.argv[3]))
     else:
-        main()
+        # the close record (cumulative retrace counts) must land on every
+        # exit path — gate sys.exit(1)s and _fail included
+        try:
+            main()
+        finally:
+            if _TRACER is not None:
+                _TRACER.close()

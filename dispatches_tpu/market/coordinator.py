@@ -18,6 +18,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import get_tracer
+
 
 class DoubleLoopCoordinator:
     def __init__(self, bidder, tracker, projection_tracker=None):
@@ -55,14 +57,21 @@ class DoubleLoopCoordinator:
                 gen_dict[param] = value
 
     # -- market-host callbacks ------------------------------------------
+    # each callback is a journal span so a double-loop run decomposes into
+    # per-day DA-bid / RT-bid / tracking wall-clock in the run journal
     def compute_day_ahead_bids(self, day: int, hour: int = 0):
-        return self.bidder.compute_day_ahead_bids(day, hour)
+        with get_tracer().span("da_bids", day=day, hour=hour):
+            return self.bidder.compute_day_ahead_bids(day, hour)
 
     def compute_real_time_bids(self, day: int, hour: int, da_prices=None, da_dispatches=None):
-        return self.bidder.compute_real_time_bids(day, hour, da_prices, da_dispatches)
+        with get_tracer().span(
+            "rt_bids", day=day, hour=hour, has_da=da_prices is not None
+        ):
+            return self.bidder.compute_real_time_bids(day, hour, da_prices, da_dispatches)
 
     def track_sced_dispatch(self, dispatch, day: int, hour: int):
-        return self.tracker.track_market_dispatch(dispatch, day, hour)
+        with get_tracer().span("track_sced", day=day, hour=hour):
+            return self.tracker.track_market_dispatch(dispatch, day, hour)
 
     # -- Prescient interop (optional dependency) -------------------------
     @property

@@ -1,0 +1,62 @@
+"""Structured observability for dispatches_tpu.
+
+Three pillars (see docs/observability.md):
+
+1. **Per-iteration solver traces** (`obs.trace`): jit/vmap-safe
+   `SolveTrace` pytrees recorded inside solver loops via `trace=True`.
+2. **Span-based run journals** (`obs.journal`): append-only JSONL with a
+   reproducibility manifest, nested spans, and solve summaries.
+3. **Compile & memory accounting** (`obs.retrace`, `obs.memory`): jit
+   cache-miss counters per function signature and best-effort device
+   memory watermarks.
+"""
+from .journal import (  # noqa: F401
+    NullTracer,
+    Tracer,
+    build_manifest,
+    get_tracer,
+    read_journal,
+    set_tracer,
+    use_tracer,
+)
+from .memory import device_memory_stats, memory_watermark_bytes  # noqa: F401
+from .retrace import (  # noqa: F401
+    note_trace,
+    reset_retrace_counts,
+    retrace_counts,
+    retrace_delta,
+    signature_of,
+    total_retraces,
+)
+from .trace import (  # noqa: F401
+    SolveTrace,
+    empty_trace,
+    flag_divergent,
+    record,
+    recorded_iterations,
+    trace_stats,
+)
+
+__all__ = [
+    "SolveTrace",
+    "empty_trace",
+    "record",
+    "recorded_iterations",
+    "flag_divergent",
+    "trace_stats",
+    "Tracer",
+    "NullTracer",
+    "build_manifest",
+    "get_tracer",
+    "set_tracer",
+    "use_tracer",
+    "read_journal",
+    "note_trace",
+    "retrace_counts",
+    "retrace_delta",
+    "total_retraces",
+    "reset_retrace_counts",
+    "signature_of",
+    "device_memory_stats",
+    "memory_watermark_bytes",
+]

@@ -1,0 +1,374 @@
+"""Span-based run journal (observability pillar 2).
+
+A :class:`Tracer` emits append-only JSONL: the first record of every run is
+a **manifest** (git SHA, jax/jaxlib versions, device kind, mesh shape,
+precision env) so any BENCH/sweep artifact is reproducible from its
+journal alone. Work is structured as nested spans::
+
+    tracer = Tracer("runs/year.jsonl")
+    with tracer.span("year_sweep"):
+        with tracer.span("point_3", ratio=4.0):
+            ...
+
+Each span close emits wall-clock seconds, the retrace-count delta observed
+inside the span, and a best-effort device-memory watermark. Solve results
+go through :meth:`Tracer.solve_event`, which embeds the same ``batch_stats``
+summary the telemetry layer uses.
+
+Design constraints honoured here:
+ - **No JAX backend initialization.** Manifest device info is collected
+   only if a backend already exists (`obs.memory._live_devices`), so a
+   `Tracer` created before `force_virtual_cpu_mesh()` (workflow CLI
+   `--platform cpu`, tests/conftest.py) cannot pin the platform.
+ - **Append-only + flush per record**, so a SIGKILL'd bench run (see
+   bench.py's watchdog) still leaves a readable prefix.
+ - **Null object pattern**: library code calls `get_tracer()` and journals
+   unconditionally; with no tracer installed that's a few dict ops.
+"""
+from __future__ import annotations
+
+import json
+import os
+import platform
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from . import memory as _memory
+from . import retrace as _retrace
+
+_SCHEMA_VERSION = 1
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:
+        return None
+
+
+def _versions() -> Dict[str, Any]:
+    v: Dict[str, Any] = {"python": sys.version.split()[0]}
+    try:
+        import jax
+
+        v["jax"] = jax.__version__
+    except Exception:
+        pass
+    try:
+        import jaxlib
+
+        v["jaxlib"] = jaxlib.__version__
+    except Exception:
+        pass
+    try:
+        import numpy
+
+        v["numpy"] = numpy.__version__
+    except Exception:
+        pass
+    return v
+
+
+def _device_info() -> Dict[str, Any]:
+    """Device kind / count / mesh shape, only from an already-initialized
+    backend — never forces backend init (see module docstring)."""
+    devs = _memory._live_devices()
+    if not devs:
+        return {"device_kind": None, "device_count": None, "mesh_shape": None}
+    info: Dict[str, Any] = {
+        "device_kind": getattr(devs[0], "device_kind", None),
+        "platform": getattr(devs[0], "platform", None),
+        "device_count": len(devs),
+        "mesh_shape": [len(devs)],
+    }
+    return info
+
+
+def _precision_env() -> Dict[str, Any]:
+    env = {
+        k: os.environ[k]
+        for k in (
+            "JAX_PLATFORMS",
+            "JAX_ENABLE_X64",
+            "XLA_FLAGS",
+            "DISPATCHES_TPU_MATMUL_PRECISION",
+        )
+        if k in os.environ
+    }
+    try:
+        import jax
+
+        env["jax_enable_x64"] = bool(jax.config.jax_enable_x64)
+    except Exception:
+        pass
+    return env
+
+
+def build_manifest(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    m: Dict[str, Any] = {
+        "kind": "manifest",
+        "schema": _SCHEMA_VERSION,
+        "ts": time.time(),
+        "run_id": uuid.uuid4().hex[:12],
+        "git_sha": _git_sha(),
+        "argv": list(sys.argv),
+        "host": platform.node(),
+        "os": platform.platform(),
+        "versions": _versions(),
+        "precision": _precision_env(),
+    }
+    m.update(_device_info())
+    if extra:
+        m.update(extra)
+    return m
+
+
+class Tracer:
+    """Append-only JSONL run journal with nested spans.
+
+    `path=None` keeps events in memory only (`self.events`) — handy for
+    tests and for deriving legacy artifacts (bench.py's BENCH_DIAG.json).
+    """
+
+    def __init__(self, path: Optional[str] = None, manifest_extra: Optional[dict] = None):
+        self.path = str(path) if path else None
+        self.events: List[dict] = []
+        self._lock = threading.RLock()
+        self._stack: List[str] = []
+        self._fh = None
+        if self.path:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self.manifest = build_manifest(manifest_extra)
+        self._emit(self.manifest)
+
+    # -- plumbing ------------------------------------------------------
+    def _emit(self, rec: dict) -> None:
+        with self._lock:
+            self.events.append(rec)
+            if self._fh is not None:
+                json.dump(rec, self._fh, default=_json_default)
+                self._fh.write("\n")
+                self._fh.flush()
+
+    def _span_path(self, name: str) -> str:
+        return "/".join(self._stack + [name]) if self._stack else name
+
+    # -- public API ----------------------------------------------------
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        """Nested span context. Emits `span_start` and `span_end` records;
+        the end record carries wall_s, per-function retrace deltas seen
+        inside the span, and a device-memory watermark when available."""
+        with self._lock:
+            path = self._span_path(name)
+            self._stack.append(name)
+        self._emit({"kind": "span_start", "ts": time.time(), "span": path, **attrs})
+        before = _retrace.retrace_counts()
+        t0 = time.perf_counter()
+        ok = True
+        try:
+            yield self
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            wall = time.perf_counter() - t0
+            delta = _retrace.retrace_delta(before, _retrace.retrace_counts())
+            rec = {
+                "kind": "span_end",
+                "ts": time.time(),
+                "span": path,
+                "wall_s": wall,
+                "ok": ok,
+                "retraces": delta,
+            }
+            wm = _memory.memory_watermark_bytes()
+            if wm is not None:
+                rec["mem_watermark_bytes"] = wm
+            self._emit(rec)
+            with self._lock:
+                if self._stack and self._stack[-1] == name:
+                    self._stack.pop()
+
+    def event(self, name: str, **attrs: Any) -> None:
+        self._emit(
+            {
+                "kind": "event",
+                "ts": time.time(),
+                "name": name,
+                "span": "/".join(self._stack) or None,
+                **attrs,
+            }
+        )
+
+    def metric(self, name: str, value: Any, **attrs: Any) -> None:
+        self._emit(
+            {
+                "kind": "metric",
+                "ts": time.time(),
+                "name": name,
+                "value": value,
+                "span": "/".join(self._stack) or None,
+                **attrs,
+            }
+        )
+
+    def solve_event(self, name: str, sol: Any, trace: Any = None, **attrs: Any) -> None:
+        """Record a solve result: `batch_stats` summary of `sol` plus, when
+        a `SolveTrace` is supplied, its host-side trajectory stats."""
+        rec: Dict[str, Any] = {
+            "kind": "solve",
+            "ts": time.time(),
+            "name": name,
+            "span": "/".join(self._stack) or None,
+            **attrs,
+        }
+        try:
+            from ..runtime.telemetry import batch_stats
+
+            rec["stats"] = batch_stats(sol)
+        except Exception as e:  # stats must never kill the run they document
+            rec["stats_error"] = f"{type(e).__name__}: {e}"
+        if trace is not None:
+            try:
+                from .trace import trace_stats
+
+                rec["trace"] = trace_stats(trace)
+            except Exception as e:
+                rec["trace_error"] = f"{type(e).__name__}: {e}"
+        self._emit(rec)
+
+    def close(self) -> None:
+        """Emit a final record with cumulative retrace counts and close the
+        file. Idempotent."""
+        with self._lock:
+            if self._fh is None and any(e.get("kind") == "close" for e in self.events):
+                return
+        self._emit(
+            {
+                "kind": "close",
+                "ts": time.time(),
+                "retrace_totals": _retrace.total_retraces(),
+            }
+        )
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "Tracer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NullTracer:
+    """Inert stand-in so library code can journal unconditionally."""
+
+    path = None
+    events: List[dict] = []
+    manifest: Dict[str, Any] = {}
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        yield self
+
+    def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def metric(self, name: str, value: Any, **attrs: Any) -> None:
+        pass
+
+    def solve_event(self, name: str, sol: Any, trace: Any = None, **attrs: Any) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullTracer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NULL = NullTracer()
+_CURRENT: Any = _NULL
+
+
+def get_tracer():
+    """The process-wide tracer (a NullTracer when none is installed)."""
+    return _CURRENT
+
+
+def set_tracer(tracer) -> Any:
+    """Install `tracer` (None restores the NullTracer); returns the
+    previous one so callers can restore it."""
+    global _CURRENT
+    prev = _CURRENT
+    _CURRENT = tracer if tracer is not None else _NULL
+    return prev
+
+
+@contextmanager
+def use_tracer(tracer):
+    prev = set_tracer(tracer)
+    try:
+        yield tracer
+    finally:
+        set_tracer(prev)
+
+
+def read_journal(path: str) -> List[dict]:
+    """Parse a JSONL journal, skipping torn trailing lines (a killed run
+    may leave a partial final record)."""
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def _json_default(o: Any):
+    """Fallback serializer: numpy/JAX scalars and arrays -> Python."""
+    try:
+        import numpy as np
+
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.bool_):
+            return bool(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except Exception:
+        pass
+    if hasattr(o, "tolist"):
+        try:
+            return o.tolist()
+        except Exception:
+            pass
+    return repr(o)

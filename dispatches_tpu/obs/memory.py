@@ -1,0 +1,56 @@
+"""Best-effort device-memory watermark sampling.
+
+TPU/GPU backends expose `Device.memory_stats()` with allocator watermarks;
+CPU does not. Everything here degrades to `None` rather than raising, and
+— critically for the test suite — never *initializes* a JAX backend: we
+only look at devices if a backend already exists, so importing/journaling
+before `force_virtual_cpu_mesh()` stays safe.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _live_devices():
+    """Devices of an already-initialized backend, else []. Never triggers
+    backend initialization (which would pin the platform/device count
+    before the workflow CLI or conftest can configure it)."""
+    try:
+        from jax._src import xla_bridge
+
+        if not getattr(xla_bridge, "_backends", None):
+            return []
+        import jax
+
+        return jax.devices()
+    except Exception:
+        return []
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Per-device `memory_stats()` snapshots keyed by device string, or
+    None when unavailable (CPU backend, no backend yet, old jaxlib)."""
+    devs = _live_devices()
+    out = {}
+    for d in devs:
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        if st:
+            out[str(d)] = {k: int(v) for k, v in st.items() if isinstance(v, (int,))}
+    return out or None
+
+
+def memory_watermark_bytes() -> Optional[int]:
+    """Max `peak_bytes_in_use` (or `bytes_in_use` fallback) across devices,
+    or None when the backend doesn't report memory stats."""
+    stats = device_memory_stats()
+    if not stats:
+        return None
+    peaks = []
+    for st in stats.values():
+        v = st.get("peak_bytes_in_use", st.get("bytes_in_use"))
+        if v is not None:
+            peaks.append(int(v))
+    return max(peaks) if peaks else None

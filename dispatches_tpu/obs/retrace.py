@@ -1,0 +1,83 @@
+"""Retrace / compile-cache-miss accounting (observability pillar 3).
+
+A recompile storm in a sweep — shape drift, a forgotten static argname, a
+weak-type flip — shows up as a mystery 10-100x slowdown. This module makes
+it a *metric*: each instrumented jit entry point calls :func:`note_trace`
+from inside its Python function body, which executes exactly once per
+compilation-cache miss (JAX only runs the Python body when tracing), so the
+count of calls per distinct signature is the retrace count.
+
+Usage, inside the to-be-jitted function::
+
+    def _solve_inner(lp, tol):
+        note_trace("solve_lp", signature=f"{lp.A.shape}/{lp.A.dtype}")
+        ...
+
+The registry is process-global, lock-guarded, and cheap to snapshot/delta
+around a span (the journal's :class:`~dispatches_tpu.obs.journal.Tracer`
+attaches per-span retrace deltas automatically).
+"""
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from typing import Dict
+
+_LOCK = threading.Lock()
+_COUNTS: Dict[str, Counter] = {}
+
+
+def note_trace(name: str, signature: str = "") -> None:
+    """Record one trace (= one jit cache miss) of `name` at `signature`.
+
+    Call this from *inside* the function handed to `jax.jit`: the body only
+    runs when JAX traces it, so every call is a compilation-cache miss.
+    """
+    with _LOCK:
+        _COUNTS.setdefault(name, Counter())[signature] += 1
+
+
+def retrace_counts() -> Dict[str, Dict[str, int]]:
+    """Snapshot of {fn_name: {signature: n_traces}}."""
+    with _LOCK:
+        return {name: dict(c) for name, c in _COUNTS.items()}
+
+
+def total_retraces() -> Dict[str, int]:
+    """Total traces per function name, summed over signatures."""
+    with _LOCK:
+        return {name: sum(c.values()) for name, c in _COUNTS.items()}
+
+
+def reset_retrace_counts() -> None:
+    with _LOCK:
+        _COUNTS.clear()
+
+
+def retrace_delta(
+    before: Dict[str, Dict[str, int]], after: Dict[str, Dict[str, int]]
+) -> Dict[str, int]:
+    """Per-function trace-count increase between two snapshots (only
+    nonzero entries)."""
+    out: Dict[str, int] = {}
+    for name, sigs in after.items():
+        prev = before.get(name, {})
+        d = sum(sigs.values()) - sum(prev.values())
+        if d:
+            out[name] = d
+    return out
+
+
+def signature_of(*args) -> str:
+    """Best-effort signature string from array-ish arguments: shapes and
+    dtypes for anything with them, `repr` for small scalars. Used by the
+    solvers to key their retrace counters."""
+    parts = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{tuple(shape)}:{dtype}")
+        else:
+            parts.append(repr(a))
+    return ",".join(parts)

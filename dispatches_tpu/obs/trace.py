@@ -1,0 +1,118 @@
+"""Per-iteration solver traces (observability pillar 1).
+
+A :class:`SolveTrace` is a fixed-shape pytree of per-iteration arrays —
+primal/dual residuals, duality gap, and step sizes — recorded *inside* the
+solver's `lax.while_loop`/`scan` when the caller passes ``trace=True``.
+Fixed shape means padded to ``max_iter``: unrecorded tail entries stay NaN,
+so the structure jits once and `vmap`s over a scenario batch (one
+trajectory per batch element, shape ``(B, max_iter)``).
+
+Convergence *trajectories*, not just final residuals, are what make batched
+on-device solvers debuggable (MPAX, arXiv:2412.09734; restarted-PDHG work):
+a diverging batch element, a stalled barrier, or a step-size collapse is
+visible in the trace where the end-of-solve summary only says
+``converged=False``.
+
+Everything here is pure JAX/numpy — no imports from the solver modules, so
+the solvers can import this without cycles.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class SolveTrace(NamedTuple):
+    """Per-iteration trajectories, padded to the solve's ``max_iter``.
+
+    All fields share shape ``(max_iter,)`` (``(B, max_iter)`` under vmap).
+    Entries at indices >= the solve's iteration count are NaN. For solvers
+    that check residuals every ``check_every`` iterations (PDHG), one entry
+    corresponds to one *check*, not one iteration.
+    """
+
+    res_primal: jnp.ndarray  # relative primal residual per iteration
+    res_dual: jnp.ndarray  # relative dual residual per iteration
+    gap: jnp.ndarray  # relative complementarity / duality gap
+    step_primal: jnp.ndarray  # primal step size taken (alpha_p)
+    step_dual: jnp.ndarray  # dual step size taken (alpha_d)
+
+
+def empty_trace(length: int, dtype=jnp.float32) -> SolveTrace:
+    """NaN-filled trace buffers of `length` entries (0 = inert carry: the
+    solvers thread an empty trace through their loop state when tracing is
+    off, so the loop structure is identical either way)."""
+    buf = jnp.full((length,), jnp.nan, dtype)
+    return SolveTrace(buf, buf, buf, buf, buf)
+
+
+def record(tr: SolveTrace, it, rp, rd, gap, ap, ad) -> SolveTrace:
+    """Write one iteration's scalars at index `it` (a traced int)."""
+    return SolveTrace(
+        res_primal=tr.res_primal.at[it].set(rp),
+        res_dual=tr.res_dual.at[it].set(rd),
+        gap=tr.gap.at[it].set(gap),
+        step_primal=tr.step_primal.at[it].set(ap),
+        step_dual=tr.step_dual.at[it].set(ad),
+    )
+
+
+# ----------------------------------------------------------------------
+# Host-side readers
+# ----------------------------------------------------------------------
+def recorded_iterations(tr: SolveTrace) -> np.ndarray:
+    """Number of recorded entries per trajectory (finite-prefix length of
+    `res_primal` along the last axis). Shape () unbatched, (B,) batched."""
+    rp = np.asarray(tr.res_primal)
+    return np.isfinite(rp).sum(axis=-1)
+
+
+def flag_divergent(tr: SolveTrace, blowup: float = 1e3) -> np.ndarray:
+    """Boolean per-trajectory flag: the gap trajectory ends more than
+    `blowup` x above its running minimum, or a non-finite value appears
+    *before* the last recorded entry (mid-solve breakdown). NaN padding
+    after the last entry is normal and not flagged."""
+    gap = np.asarray(tr.gap)
+    gap2 = np.atleast_2d(gap)
+    n_rec = np.isfinite(np.atleast_2d(np.asarray(tr.res_primal))).sum(axis=-1)
+    out = np.zeros(gap2.shape[0], dtype=bool)
+    for b in range(gap2.shape[0]):
+        g = gap2[b, : max(int(n_rec[b]), 0)]
+        fin = g[np.isfinite(g)]
+        if len(g) == 0:
+            continue
+        if len(fin) < len(g):  # non-finite inside the recorded region
+            out[b] = True
+            continue
+        if len(fin) and fin[-1] > blowup * max(fin.min(), 1e-300):
+            out[b] = True
+    return out if gap.ndim > 1 else out[0]
+
+
+def trace_stats(tr: SolveTrace) -> dict:
+    """Compact host-side summary of a (possibly batched) trace: recorded
+    lengths, final residuals/gap per trajectory, divergence flags."""
+    n_rec = np.atleast_1d(recorded_iterations(tr))
+    gap = np.atleast_2d(np.asarray(tr.gap))
+    rp = np.atleast_2d(np.asarray(tr.res_primal))
+    rd = np.atleast_2d(np.asarray(tr.res_dual))
+    B = gap.shape[0]
+    fin_gap, fin_rp, fin_rd = [], [], []
+    for b in range(B):
+        k = max(int(n_rec[b]) - 1, 0)
+        fin_gap.append(float(gap[b, k]) if gap.shape[1] else float("nan"))
+        fin_rp.append(float(rp[b, k]) if rp.shape[1] else float("nan"))
+        fin_rd.append(float(rd[b, k]) if rd.shape[1] else float("nan"))
+    div = np.atleast_1d(flag_divergent(tr))
+    return {
+        "batch": int(B),
+        "recorded_iterations": [int(v) for v in n_rec],
+        "final_gap": fin_gap,
+        "final_res_primal": fin_rp,
+        "final_res_dual": fin_rd,
+        "divergent": [bool(v) for v in div],
+        "n_divergent": int(div.sum()),
+    }

@@ -34,6 +34,22 @@ class SolveRecord:
     gap: float
     wall_s: float
     batch: int = 1
+    failed: bool = False  # fn raised; `error` holds the exception type
+    error: str = ""
+
+
+def _field_max(sol, field, default=float("nan")) -> float:
+    """max of a solution field, tolerating absent fields (PDHGSolution has
+    no `gap`/`status`), non-array values, and all-NaN arrays."""
+    v = getattr(sol, field, None)
+    if v is None:
+        return default
+    try:
+        arr = np.atleast_1d(np.asarray(v, dtype=np.float64))
+    except (TypeError, ValueError):
+        return default
+    fin = arr[np.isfinite(arr)]
+    return float(fin.max()) if fin.size else default
 
 
 class SolveTelemetry:
@@ -43,22 +59,46 @@ class SolveTelemetry:
         self.records: List[SolveRecord] = []
 
     def observe(self, name: str, fn, *args, **kwargs):
-        """Run `fn(*args, **kwargs)` (returning an IPM/NLP solution) and
-        record its telemetry. Returns the solution unchanged."""
+        """Run `fn(*args, **kwargs)` and record its telemetry; returns the
+        result unchanged. Tolerates results that are not solution pytrees
+        (tuples, None — recorded with NaN residuals rather than raising).
+        When `fn` raises, a `failed=True` record with the exception type is
+        appended and the exception re-raised."""
         t0 = time.perf_counter()
-        sol = fn(*args, **kwargs)
-        jax.block_until_ready(sol.x)
+        try:
+            sol = fn(*args, **kwargs)
+        except Exception as e:
+            self.records.append(
+                SolveRecord(
+                    name=name,
+                    iterations=0,
+                    converged=False,
+                    res_primal=float("nan"),
+                    res_dual=float("nan"),
+                    gap=float("nan"),
+                    wall_s=time.perf_counter() - t0,
+                    batch=0,
+                    failed=True,
+                    error=type(e).__name__,
+                )
+            )
+            raise
+        try:
+            jax.block_until_ready(sol)
+        except Exception:
+            pass  # not a pytree of arrays; wall clock still meaningful
         wall = time.perf_counter() - t0
-        conv = np.asarray(sol.converged)
-        iters = np.asarray(sol.iterations)
+        conv = np.atleast_1d(np.asarray(getattr(sol, "converged", False)))
+        iters = np.atleast_1d(np.asarray(getattr(sol, "iterations", 0)))
+        it_fin = iters[np.isfinite(iters.astype(np.float64))]
         self.records.append(
             SolveRecord(
                 name=name,
-                iterations=int(iters.max()),
+                iterations=int(it_fin.max()) if it_fin.size else 0,
                 converged=bool(conv.all()),
-                res_primal=float(np.max(np.asarray(sol.res_primal))),
-                res_dual=float(np.max(np.asarray(sol.res_dual))),
-                gap=float(np.max(np.asarray(sol.gap))),
+                res_primal=_field_max(sol, "res_primal"),
+                res_dual=_field_max(sol, "res_dual"),
+                gap=_field_max(sol, "gap"),
                 wall_s=wall,
                 batch=int(conv.size),
             )
@@ -94,21 +134,31 @@ def batch_stats(sol) -> dict:
     """Self-diagnosing statistics for a batched IPM/NLP solution: converged
     fraction, iteration histogram, and residual quantiles. The fields bench
     regressions need at a glance (round 1 shipped a bench whose metric said
-    converged=0.000 — these stats make that impossible to miss)."""
+    converged=0.000 — these stats make that impossible to miss).
+
+    NaN-hardened: a diverged f32 solve can leave NaN/Inf in the iteration
+    or residual arrays — exactly the solve these stats must diagnose, so
+    non-finite entries are clamped out of the histogram/quantiles and
+    counted in `nonfinite_count` instead of crashing the report. Fields a
+    solution type lacks (PDHG has no `gap`/`status`) are skipped."""
     conv = np.atleast_1d(np.asarray(sol.converged))
-    iters = np.atleast_1d(np.asarray(sol.iterations))
+    iters = np.atleast_1d(np.asarray(sol.iterations).astype(np.float64))
+    nonfinite = int((~np.isfinite(iters)).sum())
+    it_fin = iters[np.isfinite(iters)]
+    if it_fin.size == 0:
+        it_fin = np.zeros(1)
     # integer bin edges so rounded labels can never collide (a colliding
     # label would silently drop a bin from the dict)
-    lo, hi = int(iters.min()), int(iters.max())
+    lo, hi = int(it_fin.min()), int(it_fin.max())
     step = max(1, int(np.ceil((hi - lo + 1) / 8)))
     edges = np.arange(lo, hi + step + 1, step)
-    counts, edges = np.histogram(iters, bins=edges)
+    counts, edges = np.histogram(it_fin, bins=edges)
     stats = {
         "batch": int(conv.size),
         "converged_frac": float(conv.mean()),
         "iterations": {
             "min": lo,
-            "median": float(np.median(iters)),
+            "median": float(np.median(it_fin)),
             "max": hi,
             "hist": {
                 f"{int(edges[i])}-{int(edges[i + 1])}": int(counts[i])
@@ -117,12 +167,19 @@ def batch_stats(sol) -> dict:
         },
     }
     for field in ("res_primal", "res_dual", "gap"):
-        v = np.atleast_1d(np.asarray(getattr(sol, field)))
+        if not hasattr(sol, field):
+            continue
+        v = np.atleast_1d(np.asarray(getattr(sol, field), dtype=np.float64))
+        nonfinite += int((~np.isfinite(v)).sum())
+        vf = v[np.isfinite(v)]
+        if vf.size == 0:
+            vf = np.array([np.nan])  # all-NaN field: report NaN, don't crash
         stats[field] = {
-            "median": float(np.median(v)),
-            "p90": float(np.quantile(v, 0.9)),
-            "max": float(v.max()),
+            "median": float(np.median(vf)),
+            "p90": float(np.quantile(vf, 0.9)),
+            "max": float(vf.max()),
         }
+    stats["nonfinite_count"] = nonfinite
     if hasattr(sol, "status"):
         from ..solvers.ipm import status_name
 

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.program import LPData
+from ..obs.retrace import note_trace, signature_of
+from ..obs.trace import SolveTrace, empty_trace as _empty_trace, record as _tr_record
 
 # Read ONCE at import: solve_lp traces under jit, so the chosen precision is
 # baked into each trace cache — a mid-process env change could not take
@@ -101,7 +103,10 @@ def _ruiz_scaling(A, iters: int = 8):
     return r, cs
 
 
-@partial(jax.jit, static_argnames=("max_iter", "refine_steps", "stall_limit", "correctors"))
+@partial(
+    jax.jit,
+    static_argnames=("max_iter", "refine_steps", "stall_limit", "correctors", "trace"),
+)
 def solve_lp(
     lp: LPData,
     tol: float = 1e-8,
@@ -112,6 +117,7 @@ def solve_lp(
     q: jnp.ndarray = None,
     stall_limit: int = None,
     correctors: int = 0,
+    trace: bool = False,
 ) -> IPMSolution:
     """Scale (Ruiz + norm), solve, unscale. See `_solve_scaled` for the core.
 
@@ -125,15 +131,25 @@ def solve_lp(
     equations factorizable, small enough not to bias mid-box variables (a
     primal reg above the barrier weight `z/x` of a variable far from its
     bounds visibly perturbs the solution).
+
+    `trace=True` additionally returns a `SolveTrace` of per-iteration
+    relative residuals, gap, and step sizes (NaN-padded to `max_iter`); the
+    return value becomes ``(IPMSolution, SolveTrace)``. Tracing never
+    alters the iteration itself — with `trace=False` the solve is bitwise
+    identical to the untraced solver.
     """
     # TPU f32 matmuls default to bf16 passes, which destroys the
     # normal-equations Cholesky (round-1 bench: 0/416 converged). Force full
     # f32 accumulation for every dot/cholesky in the solve; no-op on CPU/f64.
     with jax.default_matmul_precision(_MATMUL_PRECISION):
-        return _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit, correctors)
+        sol, tr = _solve_lp_inner(
+            lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit, correctors, trace
+        )
+    return (sol, tr) if trace else sol
 
 
-def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit=None, correctors=0):
+def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit=None, correctors=0, trace=False):
+    note_trace("solve_lp", signature_of(*lp))
     A0, b0, c0v, l0, u0, off0 = lp
     if reg_p is None:
         reg_p = 1e-13 if A0.dtype == jnp.float64 else 1e-8
@@ -156,7 +172,7 @@ def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limi
     )
     q0 = jnp.zeros_like(c0v) if q is None else jnp.asarray(q, c0v.dtype)
     q_s = q0 * cs * cs * sig_b / sig_c
-    sol = _solve_scaled(
+    sol, tr = _solve_scaled(
         LPData(A, b / sig_b, c / sig_c, l / sig_b, u / sig_b, jnp.zeros_like(off0)),
         tol,
         max_iter,
@@ -166,6 +182,7 @@ def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limi
         q_s,
         stall_limit=stall_limit,
         correctors=correctors,
+        trace=trace,
     )
     # unscale: x = cs * x~ * sig_b ; y = sig_c * r * y~ ; z = sig_c/cs * z~
     x = sol.x * cs * sig_b
@@ -173,18 +190,21 @@ def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limi
     zl = sol.zl / cs * sig_c
     zu = sol.zu / cs * sig_c
     obj = c0v @ x + 0.5 * (q0 * x) @ x + off0
-    return IPMSolution(
-        x=x,
-        y=y,
-        zl=zl,
-        zu=zu,
-        obj=obj,
-        converged=sol.converged,
-        iterations=sol.iterations,
-        res_primal=sol.res_primal,
-        res_dual=sol.res_dual,
-        gap=sol.gap,
-        status=sol.status,
+    return (
+        IPMSolution(
+            x=x,
+            y=y,
+            zl=zl,
+            zu=zu,
+            obj=obj,
+            converged=sol.converged,
+            iterations=sol.iterations,
+            res_primal=sol.res_primal,
+            res_dual=sol.res_dual,
+            gap=sol.gap,
+            status=sol.status,
+        ),
+        tr,
     )
 
 
@@ -200,8 +220,14 @@ def _solve_scaled(
     d_cap: float = None,
     stall_limit: int = None,
     correctors: int = 0,
-) -> IPMSolution:
-    """Core Mehrotra iteration. `ops`, when given, abstracts the linear
+    trace: bool = False,
+):
+    """Core Mehrotra iteration. Returns ``(IPMSolution, SolveTrace)``; the
+    trace holds per-iteration relative residuals/gap/steps when
+    ``trace=True`` and is an inert length-0 carry otherwise (so the loop
+    structure — and the untraced results, bitwise — never change).
+
+    `ops`, when given, abstracts the linear
     algebra so structured solvers (block-tridiagonal time-banded systems,
     `solvers/structured.py`) reuse this exact loop:
       ops = (matvec, rmatvec, make_kkt_solver) with
@@ -275,11 +301,11 @@ def _solve_scaled(
         )
 
     def cond(state):
-        x, y, zl, zu, best, it, done = state
+        x, y, zl, zu, best, it, done, tr = state
         return (it < max_iter) & (~done)
 
     def body(state):
-        x, y, zl, zu, best, it, _ = state
+        x, y, zl, zu, best, it, _, tr = state
         xl = jnp.where(fl, x - l_s, 1.0)
         xu = jnp.where(fu, u_s - x, 1.0)
         zl_s = jnp.where(fl, zl, 0.0)
@@ -435,16 +461,27 @@ def _solve_scaled(
         done = (m_n < tol) | (~ok) | diverged
         if stall_limit is not None:
             done = done | ((it + 1 - best[5]) >= stall_limit)
-        return (x_n, y_n, zl_n, zu_n, best, it + 1, done)
+        if trace:  # static: the untraced loop carries tr through untouched
+            tr = _tr_record(
+                tr,
+                it,
+                jnp.linalg.norm(rp_n) / bnorm,
+                jnp.linalg.norm(rd_n) / cnorm,
+                comp_n / (1.0 + jnp.abs(c @ x_n)),
+                ap,
+                ad,
+            )
+        return (x_n, y_n, zl_n, zu_n, best, it + 1, done, tr)
 
     rp0, rd0, comp0 = residuals(x0, y0, z0l, z0u)
     best0 = (
         merit_of(rp0, rd0, comp0, x0), x0, y0, z0l, z0u, jnp.array(0)
     )
+    tr0 = _empty_trace(max_iter if trace else 0, dtype)
     state = lax.while_loop(
-        cond, body, (x0, y0, z0l, z0u, best0, jnp.array(0), jnp.array(False))
+        cond, body, (x0, y0, z0l, z0u, best0, jnp.array(0), jnp.array(False), tr0)
     )
-    _, _, _, _, best, it, done = state
+    _, _, _, _, best, it, done, tr_out = state
     _, x, y, zl, zu, _ = best
     rp, rd, comp = residuals(x, y, zl, zu)
     # report convergence from actual final residuals (the loop's `done` flag
@@ -456,18 +493,21 @@ def _solve_scaled(
     rd_rel = jnp.linalg.norm(rd) / cnorm
     gap_rel = comp / (1.0 + jnp.abs(c @ x))
     conv = (rp_rel < 100 * tol) & (rd_rel < 100 * tol) & (gap_rel < 100 * tol)
-    return IPMSolution(
-        x=x,
-        y=y,
-        zl=zl,
-        zu=zu,
-        obj=c @ x + c0,
-        converged=conv,
-        iterations=it,
-        res_primal=rp_rel,
-        res_dual=rd_rel,
-        gap=gap_rel,
-        status=_classify_exit(conv, rp_rel, rd_rel),
+    return (
+        IPMSolution(
+            x=x,
+            y=y,
+            zl=zl,
+            zu=zu,
+            obj=c @ x + c0,
+            converged=conv,
+            iterations=it,
+            res_primal=rp_rel,
+            res_dual=rd_rel,
+            gap=gap_rel,
+            status=_classify_exit(conv, rp_rel, rd_rel),
+        ),
+        tr_out,
     )
 
 

@@ -32,6 +32,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.retrace import note_trace, signature_of
+from ..obs.trace import SolveTrace, empty_trace as _empty_trace, record as _tr_record
+
 
 class NLPSolution(NamedTuple):
     x: jnp.ndarray
@@ -56,15 +59,22 @@ class _State(NamedTuple):
     gf: jnp.ndarray
     cx: jnp.ndarray
     J: jnp.ndarray
+    tr: SolveTrace  # per-iteration trajectories; length-0 carry when off
 
 
-def _kkt_error(grad_L, c, x, zl, zu, l, u, finl, finu, mu):
-    """IPOPT's E_mu (scaled residuals omitted — problems here are prescaled)."""
+def _kkt_components(grad_L, c, x, zl, zu, l, u, finl, finu, mu):
+    """(dual inf, primal inf, complementarity) — the three pieces of E_mu."""
     dual = jnp.max(jnp.abs(grad_L))
     primal = jnp.max(jnp.abs(c)) if c.shape[0] else jnp.asarray(0.0, grad_L.dtype)
     compl_l = jnp.where(finl, (x - l) * zl - mu, 0.0)
     compl_u = jnp.where(finu, (u - x) * zu - mu, 0.0)
     comp = jnp.max(jnp.maximum(jnp.abs(compl_l), jnp.abs(compl_u)))
+    return dual, primal, comp
+
+
+def _kkt_error(grad_L, c, x, zl, zu, l, u, finl, finu, mu):
+    """IPOPT's E_mu (scaled residuals omitted — problems here are prescaled)."""
+    dual, primal, comp = _kkt_components(grad_L, c, x, zl, zu, l, u, finl, finu, mu)
     return jnp.maximum(dual, jnp.maximum(primal, comp))
 
 
@@ -82,6 +92,7 @@ def _fraction_to_boundary(d, s, tau):
         "c_eq",
         "max_iter",
         "ls_steps",
+        "trace",
     ),
 )
 def solve_nlp(
@@ -95,6 +106,7 @@ def solve_nlp(
     max_iter: int = 100,
     mu0: float = 1e-1,
     ls_steps: int = 25,
+    trace: bool = False,
 ) -> NLPSolution:
     """Solve min f(x,p) s.t. c(x,p)=0, l<=x<=u from start point x0.
 
@@ -102,7 +114,13 @@ def solve_nlp(
     JAX functions (m may be 0 via an empty array). Infinite bounds are
     handled by masking. vmap over a leading batch axis of x0/params for
     scenario batches.
+
+    `trace=True` returns ``(NLPSolution, SolveTrace)`` with per-iteration
+    primal/dual infeasibility, complementarity (the `gap` field), and
+    primal/dual step sizes, NaN-padded to `max_iter`. Tracing off is
+    bitwise identical to the untraced solver.
     """
+    note_trace("solve_nlp", signature_of(x0, l, u, params))
     dtype = x0.dtype
     n = x0.shape[0]
     l = jnp.broadcast_to(jnp.asarray(l, dtype), (n,))
@@ -145,6 +163,7 @@ def solve_nlp(
         gf=grad_f(x_init),
         cx=c(x_init) if m else jnp.zeros((0,), dtype),
         J=jac_c(x_init) if m else jnp.zeros((0, n), dtype),
+        tr=_empty_trace(max_iter if trace else 0, dtype),
     )
 
     tau = 0.995
@@ -256,7 +275,10 @@ def solve_nlp(
         Jn = jac_c(x_new) if m else jnp.zeros((0, n), dtype)
         gL = gfn + (Jn.T @ y_new if m else 0.0) - zl_new + zu_new
         e_mu = _kkt_error(gL, cn, x_new, zl_new, zu_new, l, u, finl, finu, mu)
-        e_0 = _kkt_error(gL, cn, x_new, zl_new, zu_new, l, u, finl, finu, 0.0)
+        d0, p0, comp0 = _kkt_components(
+            gL, cn, x_new, zl_new, zu_new, l, u, finl, finu, 0.0
+        )
+        e_0 = jnp.maximum(d0, jnp.maximum(p0, comp0))
 
         mu_new = jnp.where(
             e_mu < 10.0 * mu,
@@ -264,8 +286,11 @@ def solve_nlp(
             mu,
         )
         done = e_0 < tol
+        tr = st.tr
+        if trace:  # static: the untraced loop carries tr through untouched
+            tr = _tr_record(tr, st.it, p0, d0, comp0, alpha, alpha_z)
         return _State(
-            x_new, y_new, zl_new, zu_new, mu_new, st.it + 1, done, gfn, cn, Jn
+            x_new, y_new, zl_new, zu_new, mu_new, st.it + 1, done, gfn, cn, Jn, tr
         )
 
     def cond(st: _State):
@@ -276,7 +301,7 @@ def solve_nlp(
     cxF, JF = stF.cx, stF.J
     gLF = stF.gf + (JF.T @ stF.y if m else 0.0) - stF.zl + stF.zu
     e0 = _kkt_error(gLF, cxF, stF.x, stF.zl, stF.zu, l, u, finl, finu, 0.0)
-    return NLPSolution(
+    out = NLPSolution(
         x=stF.x,
         y=stF.y,
         zl=stF.zl,
@@ -286,6 +311,7 @@ def solve_nlp(
         iterations=stF.it,
         kkt_error=e0,
     )
+    return (out, stF.tr) if trace else out
 
 
 @partial(jax.jit, static_argnames=("F", "max_iter"))

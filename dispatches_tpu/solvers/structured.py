@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.program import CompiledLP, LPData
+from ..obs.retrace import note_trace, signature_of
 from .ipm import IPMSolution, _solve_scaled
 
 
@@ -858,14 +859,15 @@ def _ruiz_banded(Ad, As, Bb, iters: int = 8):
     static_argnames=(
         "meta", "max_iter", "refine_steps", "d_cap", "slabs", "mesh",
         "chol_dtype", "kkt_refine", "inv_factors", "sweep_backend",
-        "correctors",
+        "correctors", "trace",
     ),
 )
 def _solve_banded_jit(
     meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs=None,
     mesh=None, chol_dtype=None, kkt_refine=0, fac_d_cap=None,
-    inv_factors=False, sweep_backend="xla", correctors=0,
+    inv_factors=False, sweep_backend="xla", correctors=0, trace=False,
 ):
+    note_trace("solve_lp_banded", signature_of(*blp))
     Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0 = blp
     dtype = Ad.dtype
     Tb, mB, nB = Ad.shape
@@ -899,7 +901,7 @@ def _solve_banded_jit(
             fac_d_cap=fac_d_cap, inv_factors=inv_factors,
             sweep_backend=sweep_backend,
         )
-        sol = _solve_scaled(
+        sol, tr = _solve_scaled(
             LPData(
                 A=None,
                 b=b_s / sig_b,
@@ -917,6 +919,7 @@ def _solve_banded_jit(
             ops=ops,
             d_cap=d_cap,
             correctors=correctors,
+            trace=trace,
         )
         # unscale and map back to the CompiledLP's reduced column order
         x_flat = sol.x * cs_all * sig_b
@@ -929,7 +932,7 @@ def _solve_banded_jit(
             + cb @ x_flat[nt:]
             + c0
         )
-    return IPMSolution(
+    out = IPMSolution(
         x=x_red,
         y=y,
         zl=zl,
@@ -942,6 +945,7 @@ def _solve_banded_jit(
         gap=sol.gap,
         status=sol.status,
     )
+    return (out, tr) if trace else out
 
 
 class SmallTF32Warning(UserWarning):
@@ -988,6 +992,7 @@ def solve_lp_banded(
     inv_factors: bool = False,
     sweep_backend: str = "xla",
     correctors: int = 0,
+    trace: bool = False,
 ) -> IPMSolution:
     """Solve a time-banded LP by the block-tridiagonal IPM. Returns a
     solution with ``x`` in the CompiledLP's reduced column order, so
@@ -1039,7 +1044,12 @@ def solve_lp_banded(
     (plain f32 data, or float64 with ``chol_dtype=float32``); not
     combinable with ``mesh`` (multi-chip keeps the XLA sweeps). On
     non-TPU backends the same kernel runs under the Pallas interpreter
-    (tests), so results are backend-independent."""
+    (tests), so results are backend-independent.
+
+    ``trace=True`` additionally returns the per-iteration `SolveTrace`
+    (relative residuals, gap, step sizes, NaN-padded to ``max_iter``); the
+    return value becomes ``(IPMSolution, SolveTrace)``. Tracing off is
+    bitwise identical to the untraced solver."""
     _warn_small_T_f32(meta, blp)
     dtype = blp.Ad.dtype
     if chol_dtype is not None:
@@ -1110,7 +1120,7 @@ def solve_lp_banded(
     return _solve_banded_jit(
         meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs,
         mesh, chol_dtype, kkt_refine, fac_d_cap, inv_factors, sweep_backend,
-        correctors,
+        correctors, trace,
     )
 
 

@@ -23,6 +23,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs import get_tracer
 from ..runtime.native import ResultStore
 from .options import SimulationOptions
 
@@ -49,6 +50,7 @@ def run_pricetaker(
     h2_prices: Optional[List[float]] = None,
     store_path: Optional[str] = None,
     verbose: bool = True,
+    tracer=None,
 ):
     """Price-taker design sweep over H2 prices with checkpoint/skip."""
     from ..case_studies.renewables import params as P
@@ -58,53 +60,64 @@ def run_pricetaker(
         wind_battery_pem_tank_turb_optimize,
     )
 
+    tracer = tracer if tracer is not None else get_tracer()
     data = P.load_rts303()
     h2_prices = h2_prices or [2.0]
     store = ResultStore(store_path) if store_path else None
     done = set(store.keys()) if store else set()
 
     out = []
-    for i, h2 in enumerate(h2_prices):
-        key = _point_key(topology, hours, h2)
-        if key in done:
+    with tracer.span("pricetaker", topology=topology, hours=hours):
+        for i, h2 in enumerate(h2_prices):
+            key = _point_key(topology, hours, h2)
+            if key in done:
+                if verbose:
+                    print(f"[{i}] h2=${h2}/kg: checkpointed, skipping")
+                tracer.event("skip_checkpointed", point=i, h2_price=h2)
+                continue
+            with tracer.span(f"point_{i}", h2_price=h2):
+                if topology == "wind_battery":
+                    res = wind_battery_optimize(
+                        hours, data["da_lmp"], data["da_wind_cf"]
+                    )
+                elif topology == "wind_pem":
+                    res = wind_battery_pem_optimize(
+                        hours, data["da_lmp"], data["da_wind_cf"], h2_price_per_kg=h2
+                    )
+                elif topology == "wind_pem_tank_turbine":
+                    res = wind_battery_pem_tank_turb_optimize(
+                        hours, data["da_lmp"], data["da_wind_cf"], h2_price_per_kg=h2
+                    )
+                else:
+                    raise ValueError(f"topology must be one of {TOPOLOGIES}")
+            rec = {
+                "h2_price": h2,
+                "NPV": res["NPV"],
+                "annual_revenue": res["annual_revenue"],
+                "pem_kw": res.get("pem_kw", 0.0),
+                "batt_kw": res.get("batt_kw", 0.0),
+                "solver_stats": res.get("solver_stats", {}),
+            }
+            out.append(rec)
+            tracer.event(
+                "point_result", point=i, h2_price=h2, NPV=rec["NPV"],
+                solver_stats=rec["solver_stats"],
+            )
+            if store:
+                store.append(
+                    key,
+                    [h2, rec["NPV"], rec["annual_revenue"], rec["pem_kw"], rec["batt_kw"]],
+                )
             if verbose:
-                print(f"[{i}] h2=${h2}/kg: checkpointed, skipping")
-            continue
-        if topology == "wind_battery":
-            res = wind_battery_optimize(hours, data["da_lmp"], data["da_wind_cf"])
-        elif topology == "wind_pem":
-            res = wind_battery_pem_optimize(
-                hours, data["da_lmp"], data["da_wind_cf"], h2_price_per_kg=h2
-            )
-        elif topology == "wind_pem_tank_turbine":
-            res = wind_battery_pem_tank_turb_optimize(
-                hours, data["da_lmp"], data["da_wind_cf"], h2_price_per_kg=h2
-            )
-        else:
-            raise ValueError(f"topology must be one of {TOPOLOGIES}")
-        rec = {
-            "h2_price": h2,
-            "NPV": res["NPV"],
-            "annual_revenue": res["annual_revenue"],
-            "pem_kw": res.get("pem_kw", 0.0),
-            "batt_kw": res.get("batt_kw", 0.0),
-        }
-        out.append(rec)
-        if store:
-            store.append(
-                key,
-                [h2, rec["NPV"], rec["annual_revenue"], rec["pem_kw"], rec["batt_kw"]],
-            )
-        if verbose:
-            st = res.get("solver_stats", {})
-            it = st.get("iterations", {})
-            print(
-                f"[{i}] h2=${h2}/kg: NPV ${rec['NPV']:.3e} "
-                f"pem {rec['pem_kw']:.0f} kW | converged "
-                f"{st.get('converged_frac', float('nan')):.3f}, "
-                f"iters {it.get('median', '?')}, "
-                f"gap {st.get('gap', {}).get('max', float('nan')):.1e}"
-            )
+                st = res.get("solver_stats", {})
+                it = st.get("iterations", {})
+                print(
+                    f"[{i}] h2=${h2}/kg: NPV ${rec['NPV']:.3e} "
+                    f"pem {rec['pem_kw']:.0f} kW | converged "
+                    f"{st.get('converged_frac', float('nan')):.3f}, "
+                    f"iters {it.get('median', '?')}, "
+                    f"gap {st.get('gap', {}).get('max', float('nan')):.1e}"
+                )
     return out
 
 
@@ -115,6 +128,7 @@ def run_battery_ratio_sweep(
     wind_mw: float = None,
     store_path: Optional[str] = None,
     verbose: bool = True,
+    tracer=None,
 ):
     """Battery sizing sweep over (capacity ratio, duration-hours) — the
     reference's `run_pricetaker_battery_ratio_size.py` (one CBC subprocess
@@ -124,6 +138,7 @@ def run_battery_ratio_sweep(
     from ..case_studies.renewables import params as P
     from ..case_studies.renewables.pricetaker import wind_battery_optimize
 
+    tracer = tracer if tracer is not None else get_tracer()
     data = P.load_rts303()
     if wind_mw is None:
         wind_mw = P.FIXED_WIND_MW
@@ -131,39 +146,48 @@ def run_battery_ratio_sweep(
     store = ResultStore(store_path) if store_path else None
     done = set(store.keys()) if store else set()
     out = []
-    for i, (ratio, dur) in enumerate(grid):
-        key = _point_key(ratio, dur, hours, wind_mw)
-        if key in done:
+    with tracer.span("battery_ratio_sweep", hours=hours, points=len(grid)):
+        for i, (ratio, dur) in enumerate(grid):
+            key = _point_key(ratio, dur, hours, wind_mw)
+            if key in done:
+                if verbose:
+                    print(f"[{i}] ratio={ratio} dur={dur}h: checkpointed, skipping")
+                tracer.event("skip_checkpointed", point=i, ratio=ratio, duration=dur)
+                continue
+            with tracer.span(f"point_{i}", ratio=ratio, duration_hrs=dur):
+                res = wind_battery_optimize(
+                    hours,
+                    data["da_lmp"],
+                    data["da_wind_cf"],
+                    batt_mw=ratio * wind_mw,
+                    wind_mw=wind_mw,
+                    design_opt=False,
+                    battery_duration_hrs=float(dur),
+                )
+            rec = {
+                "battery_ratio": ratio,
+                "duration_hrs": dur,
+                "batt_mw": ratio * wind_mw,
+                "NPV": res["NPV"],
+                "annual_revenue": res["annual_revenue"],
+                "converged": bool(res["converged"]),
+                "solver_stats": res.get("solver_stats", {}),
+            }
+            out.append(rec)
+            tracer.event(
+                "point_result", point=i, ratio=ratio, duration_hrs=dur,
+                NPV=rec["NPV"], converged=rec["converged"],
+                solver_stats=rec["solver_stats"],
+            )
+            if store and rec["converged"]:
+                store.append(
+                    key, [ratio, float(dur), rec["NPV"], rec["annual_revenue"]]
+                )
             if verbose:
-                print(f"[{i}] ratio={ratio} dur={dur}h: checkpointed, skipping")
-            continue
-        res = wind_battery_optimize(
-            hours,
-            data["da_lmp"],
-            data["da_wind_cf"],
-            batt_mw=ratio * wind_mw,
-            wind_mw=wind_mw,
-            design_opt=False,
-            battery_duration_hrs=float(dur),
-        )
-        rec = {
-            "battery_ratio": ratio,
-            "duration_hrs": dur,
-            "batt_mw": ratio * wind_mw,
-            "NPV": res["NPV"],
-            "annual_revenue": res["annual_revenue"],
-            "converged": bool(res["converged"]),
-        }
-        out.append(rec)
-        if store and rec["converged"]:
-            store.append(
-                key, [ratio, float(dur), rec["NPV"], rec["annual_revenue"]]
-            )
-        if verbose:
-            print(
-                f"[{i}] ratio={ratio} dur={dur}h: NPV ${rec['NPV']:.3e} "
-                f"rev ${rec['annual_revenue']:.3e}"
-            )
+                print(
+                    f"[{i}] ratio={ratio} dur={dur}h: NPV ${rec['NPV']:.3e} "
+                    f"rev ${rec['annual_revenue']:.3e}"
+                )
     return out
 
 
@@ -181,6 +205,8 @@ def run_year_sweep(
     inv_factors: bool = False,
     store_path: Optional[str] = None,
     verbose: bool = True,
+    tracer=None,
+    trace: bool = False,
 ):
     """Year-scale LMP-scenario design sweep — the BASELINE.md north-star
     workload as a user entry point: N full-year (8,760 h) wind+battery+PEM
@@ -198,7 +224,11 @@ def run_year_sweep(
     `solve_lp_banded` — pair correctors with mixed precision, not pure
     f32 (docs/solvers.md). Scenario draws are
     deterministic in `seed`, so the ResultStore checkpoint keys stay
-    aligned across resumed runs (solved scenarios are skipped)."""
+    aligned across resumed runs (solved scenarios are skipped).
+
+    `trace=True` threads per-iteration `SolveTrace` recording through the
+    batched banded solves; trajectory summaries land in the journal's
+    per-batch solve events (`tracer`, default the process tracer)."""
     import jax
     import jax.numpy as jnp
 
@@ -207,10 +237,13 @@ def run_year_sweep(
         HybridDesign,
         build_pricetaker,
     )
+    from ..runtime.telemetry import batch_stats
     from ..solvers.structured import (
         extract_time_structure,
         solve_lp_banded_batch,
     )
+
+    tracer = tracer if tracer is not None else get_tracer()
 
     if dtype == "float64" or dtype == jnp.float64:
         # without x64 the f64 request silently truncates to f32 and the
@@ -285,46 +318,59 @@ def run_year_sweep(
     ]
     if verbose and len(pending) < scenarios:
         print(f"{scenarios - len(pending)} scenarios checkpointed, skipping")
-    for lo in range(0, len(pending), batch):
-        todo = pending[lo : lo + batch]
-        # pad to the fixed batch width so every iteration reuses ONE
-        # compiled executable (a varying batch dimension would retrace and
-        # recompile the year-scale solve per distinct shape)
-        padded = todo + [todo[-1]] * (batch - len(todo))
-        lmps = jnp.asarray(
-            np.asarray(scales)[padded, None] * base_lmp[None, :], jdtype
-        )
-        blp_b = jax.vmap(
-            lambda lm: meta.instantiate({"lmp": lm, "wind_cf": cf}, dtype=jdtype)
-        )(lmps)
-        sol = solve_lp_banded_batch(meta, blp_b, **solver_kw)
-        convs = np.asarray(sol.converged)[: len(todo)]
-        npvs = np.asarray(
-            jax.vmap(
-                lambda x, lm: prog.eval_expr(
-                    "NPV", x, {"lmp": lm, "wind_cf": cf}
-                )
-            )(sol.x, lmps)
-        )[: len(todo)]
-        for j, k in enumerate(todo):
-            rec = {
-                "scenario": k,
-                "lmp_scale": float(scales[k]),
-                "NPV": float(npvs[j]),
-                "converged": bool(convs[j]),
-            }
-            out.append(rec)
-            # only CONVERGED scenarios checkpoint: an unconverged one must
-            # stay re-solvable on resume (and its NPV must not be cached
-            # as an answer)
-            if store and rec["converged"]:
-                store.append(skeys[k], [rec["lmp_scale"], rec["NPV"], 1.0])
-        if verbose:
-            print(
-                f"[{todo[0]}..{todo[-1]}] {len(todo)} year-LPs: "
-                f"converged {int(convs.sum())}/{len(todo)}, "
-                f"NPV ${npvs.min():.3e}..${npvs.max():.3e}"
+    with tracer.span(
+        "year_sweep", scenarios=scenarios, batch=batch, hours=hours,
+        dtype=str(jdtype),
+    ):
+        for lo in range(0, len(pending), batch):
+            todo = pending[lo : lo + batch]
+            # pad to the fixed batch width so every iteration reuses ONE
+            # compiled executable (a varying batch dimension would retrace and
+            # recompile the year-scale solve per distinct shape)
+            padded = todo + [todo[-1]] * (batch - len(todo))
+            lmps = jnp.asarray(
+                np.asarray(scales)[padded, None] * base_lmp[None, :], jdtype
             )
+            with tracer.span(
+                f"batch_{lo // batch}", scenarios=[int(k) for k in todo]
+            ):
+                blp_b = jax.vmap(
+                    lambda lm: meta.instantiate({"lmp": lm, "wind_cf": cf}, dtype=jdtype)
+                )(lmps)
+                solve_out = solve_lp_banded_batch(
+                    meta, blp_b, trace=trace, **solver_kw
+                )
+                sol, sol_tr = solve_out if trace else (solve_out, None)
+                convs = np.asarray(sol.converged)[: len(todo)]
+                npvs = np.asarray(
+                    jax.vmap(
+                        lambda x, lm: prog.eval_expr(
+                            "NPV", x, {"lmp": lm, "wind_cf": cf}
+                        )
+                    )(sol.x, lmps)
+                )[: len(todo)]
+                stats = batch_stats(sol)
+                tracer.solve_event("year_batch", sol, trace=sol_tr)
+            for j, k in enumerate(todo):
+                rec = {
+                    "scenario": k,
+                    "lmp_scale": float(scales[k]),
+                    "NPV": float(npvs[j]),
+                    "converged": bool(convs[j]),
+                    "solver_stats": stats,
+                }
+                out.append(rec)
+                # only CONVERGED scenarios checkpoint: an unconverged one must
+                # stay re-solvable on resume (and its NPV must not be cached
+                # as an answer)
+                if store and rec["converged"]:
+                    store.append(skeys[k], [rec["lmp_scale"], rec["NPV"], 1.0])
+            if verbose:
+                print(
+                    f"[{todo[0]}..{todo[-1]}] {len(todo)} year-LPs: "
+                    f"converged {int(convs.sum())}/{len(todo)}, "
+                    f"NPV ${npvs.min():.3e}..${npvs.max():.3e}"
+                )
     n_unconv = sum(1 for r in out if not r["converged"])
     if n_unconv and verbose:
         print(f"WARNING: {n_unconv} scenarios did not converge "
@@ -336,6 +382,7 @@ def run_double_loop(
     opts: Optional[SimulationOptions] = None,
     out_csv: Optional[str] = None,
     verbose: bool = True,
+    tracer=None,
 ):
     """Double-loop co-simulation on the network market (the
     `run_double_loop_PEM.py:39-211` analogue, fully in-framework)."""
@@ -388,17 +435,20 @@ def run_double_loop(
         participant_segments=opts.participant_segments,
         participant_bus=opts.participant_bus,
     )
-    results = sim.simulate(
-        n_days=opts.num_days,
-        coordinator=coord,
-        tracking_horizon=opts.tracking_horizon,
-    )
+    tracer = tracer if tracer is not None else get_tracer()
+    with tracer.span("double_loop", days=opts.num_days):
+        results = sim.simulate(
+            n_days=opts.num_days,
+            coordinator=coord,
+            tracking_horizon=opts.tracking_horizon,
+        )
     if out_csv:
         results_to_csv(results, out_csv)
     summary = summarize_revenue(
         results, lmp_key=f"LMP bus{grid.buses[0]}",
         dispatch_key="Participant [MW]",
     )
+    tracer.event("double_loop_summary", **summary)
     if verbose:
         print(json.dumps(summary))
     return results, summary
@@ -406,6 +456,11 @@ def run_double_loop(
 
 def main(argv=None):
     p = argparse.ArgumentParser(prog="dispatches-tpu")
+    p.add_argument(
+        "--journal", default=None, metavar="PATH",
+        help="append-only JSONL run journal (manifest + spans + solve "
+        "events; read it with tools/trace_summary.py)",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pt = sub.add_parser("pricetaker", help="price-taker design sweep")
@@ -458,41 +513,57 @@ def main(argv=None):
                 "before the CLI could force the host platform; start a "
                 "fresh process with JAX_PLATFORMS=cpu set instead"
             )
-    if args.cmd == "pricetaker":
-        run_pricetaker(
-            topology=args.topology,
-            hours=args.hours,
-            h2_prices=args.h2_price,
-            store_path=args.out,
-        )
-    elif args.cmd == "doubleloop":
-        opts = (
-            SimulationOptions.load(args.config)
-            if args.config
-            else SimulationOptions(num_days=args.days)
-        )
-        opts.num_days = args.days
-        run_double_loop(opts, out_csv=args.out)
-    elif args.cmd == "battsweep":
-        run_battery_ratio_sweep(
-            ratios=args.ratio,
-            durations=args.duration,
-            hours=args.hours,
-            store_path=args.out,
-        )
-    elif args.cmd == "yearsweep":
-        run_year_sweep(
-            scenarios=args.scenarios,
-            batch=args.batch,
-            hours=args.hours,
-            h2_price=args.h2_price,
-            seed=args.seed,
-            dtype=args.dtype,
-            mixed_precision=not args.no_mixed_precision,
-            correctors=args.correctors,
-            inv_factors=args.inv_factors,
-            store_path=args.out,
-        )
+    # journal AFTER platform handling: the Tracer manifest reads device info
+    # only from an already-initialized backend, never forcing one, but the
+    # ordering keeps the manifest's device fields truthful for --platform cpu
+    tracer = None
+    if args.journal:
+        from ..obs import Tracer, set_tracer
+
+        tracer = Tracer(args.journal, manifest_extra={"cmd": args.cmd})
+        set_tracer(tracer)
+    try:
+        if args.cmd == "pricetaker":
+            run_pricetaker(
+                topology=args.topology,
+                hours=args.hours,
+                h2_prices=args.h2_price,
+                store_path=args.out,
+            )
+        elif args.cmd == "doubleloop":
+            opts = (
+                SimulationOptions.load(args.config)
+                if args.config
+                else SimulationOptions(num_days=args.days)
+            )
+            opts.num_days = args.days
+            run_double_loop(opts, out_csv=args.out)
+        elif args.cmd == "battsweep":
+            run_battery_ratio_sweep(
+                ratios=args.ratio,
+                durations=args.duration,
+                hours=args.hours,
+                store_path=args.out,
+            )
+        elif args.cmd == "yearsweep":
+            run_year_sweep(
+                scenarios=args.scenarios,
+                batch=args.batch,
+                hours=args.hours,
+                h2_price=args.h2_price,
+                seed=args.seed,
+                dtype=args.dtype,
+                mixed_precision=not args.no_mixed_precision,
+                correctors=args.correctors,
+                inv_factors=args.inv_factors,
+                store_path=args.out,
+            )
+    finally:
+        if tracer is not None:
+            from ..obs import set_tracer
+
+            tracer.close()
+            set_tracer(None)
     return 0
 
 

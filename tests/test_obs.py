@@ -1,0 +1,330 @@
+"""Observability subsystem tests: jit-safe SolveTrace trajectories (incl.
+vmap + bitwise-identity with tracing off), the JSONL run journal + manifest,
+retrace accounting, telemetry failure records, and the trace_summary tool."""
+import importlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu.core.program import LPData, SparseLP
+from dispatches_tpu.obs import (
+    SolveTrace,
+    Tracer,
+    empty_trace,
+    flag_divergent,
+    read_journal,
+    recorded_iterations,
+    reset_retrace_counts,
+    retrace_counts,
+    set_tracer,
+    trace_stats,
+    use_tracer,
+)
+from dispatches_tpu.obs.retrace import note_trace, retrace_delta
+from dispatches_tpu.solvers.ipm import solve_lp
+
+INF = jnp.inf
+
+
+def _toy_lp(scale=1.0):
+    # min x1 + 2 x2  s.t. x1 + x2 = scale, x >= 0  ->  x = (scale, 0)
+    return LPData(
+        A=jnp.ones((1, 2)),
+        b=jnp.asarray([float(scale)]),
+        c=jnp.asarray([1.0, 2.0]),
+        l=jnp.zeros(2),
+        u=jnp.full(2, INF),
+        c0=jnp.asarray(0.0),
+    )
+
+
+class TestSolveTrace:
+    def test_ipm_trace_shape_and_padding(self):
+        sol, tr = solve_lp(_toy_lp(), max_iter=30, trace=True)
+        assert isinstance(tr, SolveTrace)
+        assert tr.res_primal.shape == (30,)
+        n = int(recorded_iterations(tr))
+        assert n == int(sol.iterations) and n >= 1
+        # recorded prefix is finite, the rest NaN padding
+        assert np.isfinite(np.asarray(tr.gap[:n])).all()
+        assert np.isnan(np.asarray(tr.gap[n:])).all()
+        # the complementarity gap must have dropped over the solve
+        gap = np.asarray(tr.gap[:n])
+        assert gap[-1] < gap[0]
+
+    def test_trace_off_is_bitwise_identical(self):
+        lp = _toy_lp(1.3)
+        sol_off = solve_lp(lp, max_iter=30)
+        sol_on, _ = solve_lp(lp, max_iter=30, trace=True)
+        assert np.array_equal(np.asarray(sol_off.x), np.asarray(sol_on.x))
+        assert int(sol_off.iterations) == int(sol_on.iterations)
+
+    def test_trace_under_vmap(self):
+        scales = jnp.asarray([0.5, 1.0, 2.0])
+
+        def one(s):
+            lp = LPData(
+                A=jnp.ones((1, 2)), b=jnp.asarray([s]),
+                c=jnp.asarray([1.0, 2.0]), l=jnp.zeros(2),
+                u=jnp.full(2, INF), c0=jnp.asarray(0.0),
+            )
+            return solve_lp(lp, max_iter=30, trace=True)
+
+        sol, tr = jax.vmap(one)(scales)
+        assert tr.res_primal.shape == (3, 30)
+        rec = np.asarray(recorded_iterations(tr))
+        assert rec.shape == (3,)
+        assert (rec == np.asarray(sol.iterations)).all()
+        st = trace_stats(tr)
+        assert st["batch"] == 3
+        assert len(st["final_gap"]) == 3
+        assert st["n_divergent"] == 0
+
+    def test_nlp_trace(self):
+        from dispatches_tpu.solvers.nlp import solve_nlp
+
+        f = lambda x, p: (1 - x[0]) ** 2 + 100 * (x[1] - x[0] ** 2) ** 2
+        c = lambda x, p: jnp.zeros((0,))
+        x0 = jnp.array([-1.2, 1.0])
+        sol_off = solve_nlp(f, c, x0, -INF, INF, tol=1e-8, max_iter=200)
+        sol, tr = solve_nlp(f, c, x0, -INF, INF, tol=1e-8, max_iter=200,
+                            trace=True)
+        assert bool(sol.converged)
+        assert np.array_equal(np.asarray(sol_off.x), np.asarray(sol.x))
+        assert int(recorded_iterations(tr)) == int(sol.iterations)
+
+    def test_pdhg_trace_records_per_check(self):
+        from dispatches_tpu.solvers.pdhg import solve_lp_pdhg
+
+        rng = np.random.default_rng(0)
+        m, n = 10, 20
+        A = rng.standard_normal((m, n))
+        b = A @ rng.uniform(0.5, 1.5, n)
+        rows, cols = np.nonzero(A)
+        lp = SparseLP(
+            rows=jnp.asarray(rows, jnp.int32),
+            cols=jnp.asarray(cols, jnp.int32),
+            vals=jnp.asarray(A[rows, cols]),
+            b=jnp.asarray(b),
+            c=jnp.asarray(rng.standard_normal(n)),
+            l=jnp.zeros(n),
+            u=jnp.full(n, 3.0),
+            c0=jnp.asarray(0.0),
+        )
+        sol_off = solve_lp_pdhg(lp, tol=1e-4, max_iter=20_000, check_every=100)
+        sol, tr = solve_lp_pdhg(
+            lp, tol=1e-4, max_iter=20_000, check_every=100, trace=True
+        )
+        assert np.array_equal(np.asarray(sol_off.x), np.asarray(sol.x))
+        # one record per completed convergence check, NaN-padded to the cap
+        assert tr.res_primal.shape == (200,)
+        n_checks = int(np.asarray(sol.iterations)) // 100
+        assert int(recorded_iterations(tr)) == n_checks
+
+    def test_flag_divergent(self):
+        tr = empty_trace(6)
+        gap = jnp.asarray([1.0, 0.1, 0.01, 1e4, np.nan, np.nan])
+        fin = jnp.where(jnp.isfinite(gap), 0.5, jnp.nan)
+        tr = SolveTrace(
+            res_primal=fin, res_dual=fin, gap=gap,
+            step_primal=fin, step_dual=fin,
+        )
+        assert bool(flag_divergent(tr))
+        ok = SolveTrace(
+            res_primal=fin, res_dual=fin,
+            gap=jnp.where(jnp.isfinite(gap), 0.01, jnp.nan),
+            step_primal=fin, step_dual=fin,
+        )
+        assert not bool(flag_divergent(ok))
+
+
+class TestRetrace:
+    def test_counts_per_signature(self):
+        reset_retrace_counts()
+
+        @jax.jit
+        def f(x):
+            note_trace("obs_test_fn", f"{x.shape}:{x.dtype}")
+            return x * 2
+
+        before = retrace_counts()
+        f(jnp.ones(3))
+        f(jnp.ones(3))  # cache hit: body not re-traced
+        f(jnp.ones(4))  # new shape: one more trace
+        after = retrace_counts()
+        assert after["obs_test_fn"] == {"(3,):float64": 1, "(4,):float64": 1}
+        assert retrace_delta(before, after) == {"obs_test_fn": 2}
+
+
+class TestJournal:
+    def test_roundtrip_manifest_and_spans(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        tr = Tracer(str(path), manifest_extra={"tool": "test"})
+        with tr.span("outer", k=1):
+            with tr.span("inner"):
+                tr.event("hello", x=3)
+            tr.metric("npv", 1.25)
+        tr.close()
+        evs = read_journal(str(path))
+        assert evs[0]["kind"] == "manifest"
+        man = evs[0]
+        for key in ("run_id", "git_sha", "versions", "precision", "tool"):
+            assert key in man
+        assert man["versions"].get("jax")
+        kinds = [e["kind"] for e in evs]
+        assert kinds.count("span_start") == 2
+        assert kinds.count("span_end") == 2
+        ends = {e["span"]: e for e in evs if e["kind"] == "span_end"}
+        assert "outer" in ends and "outer/inner" in ends
+        assert ends["outer"]["wall_s"] >= ends["outer/inner"]["wall_s"]
+        assert ends["outer"]["ok"] and "retraces" in ends["outer"]
+        assert evs[-1]["kind"] == "close"
+        assert "retrace_totals" in evs[-1]
+
+    def test_span_failure_marked_and_file_survives(self, tmp_path):
+        path = tmp_path / "fail.jsonl"
+        tr = Tracer(str(path))
+        with pytest.raises(ValueError):
+            with tr.span("doomed"):
+                raise ValueError("boom")
+        # no close(): simulate a killed run — the journal must still parse
+        evs = read_journal(str(path))
+        end = next(e for e in evs if e["kind"] == "span_end")
+        assert end["ok"] is False
+
+    def test_solve_event_embeds_batch_stats_and_trace(self, tmp_path):
+        sol, trc = solve_lp(_toy_lp(), max_iter=30, trace=True)
+        tr = Tracer(str(tmp_path / "s.jsonl"))
+        tr.solve_event("toy", sol, trace=trc)
+        tr.close()
+        ev = next(e for e in tr.events if e["kind"] == "solve")
+        assert ev["stats"]["converged_frac"] == 1.0
+        assert ev["trace"]["batch"] == 1
+        assert ev["trace"]["n_divergent"] == 0
+
+    def test_use_tracer_restores_previous(self):
+        t = Tracer(None)
+        prev = set_tracer(None)  # ensure the null tracer is current
+        try:
+            with use_tracer(t) as inner:
+                assert inner is t
+                from dispatches_tpu.obs import get_tracer
+
+                assert get_tracer() is t
+            from dispatches_tpu.obs import get_tracer
+
+            assert get_tracer() is not t
+        finally:
+            set_tracer(prev)
+
+
+class TestRunnerJournal:
+    def test_pricetaker_run_emits_manifest_and_spans(self, tmp_path):
+        """Acceptance: a tier-1 workflow run journals a manifest plus at
+        least one span carrying wall-clock and retrace fields."""
+        from dispatches_tpu.workflow.runners import run_pricetaker
+
+        path = tmp_path / "pt.jsonl"
+        tr = Tracer(str(path))
+        out = run_pricetaker(
+            topology="wind_battery", hours=48, h2_prices=[2.0],
+            verbose=False, tracer=tr,
+        )
+        tr.close()
+        assert len(out) == 1
+        assert "solver_stats" in out[0]
+        assert out[0]["solver_stats"].get("converged_frac") == 1.0
+        evs = read_journal(str(path))
+        assert evs[0]["kind"] == "manifest"
+        ends = [e for e in evs if e["kind"] == "span_end"]
+        assert ends, "runner emitted no spans"
+        assert all("wall_s" in e and "retraces" in e for e in ends)
+        assert any(e["span"].startswith("pricetaker") for e in ends)
+
+
+class TestTelemetrySatellites:
+    def test_observe_tolerates_solution_without_x(self):
+        from dispatches_tpu.runtime.telemetry import SolveTelemetry
+
+        tel = SolveTelemetry()
+        assert tel.observe("none", lambda: None) is None
+        assert tel.observe("tuple", lambda: (1, 2)) == (1, 2)
+        assert len(tel.records) == 2
+        assert all(not r.failed for r in tel.records)
+        assert np.isnan(tel.records[0].gap)
+
+    def test_observe_records_failure_and_reraises(self):
+        from dispatches_tpu.runtime.telemetry import SolveTelemetry
+
+        def boom():
+            raise RuntimeError("solver exploded")
+
+        tel = SolveTelemetry()
+        with pytest.raises(RuntimeError):
+            tel.observe("bad", boom)
+        rec = tel.records[-1]
+        assert rec.failed and rec.error == "RuntimeError"
+        assert not rec.converged and rec.batch == 0
+
+    def test_batch_stats_nonfinite_guard(self):
+        import collections
+
+        from dispatches_tpu.runtime.telemetry import batch_stats
+
+        Sol = collections.namedtuple(
+            "Sol", "converged iterations res_primal res_dual gap"
+        )
+        sol = Sol(
+            converged=np.array([True, False]),
+            iterations=np.array([7.0, np.nan]),
+            res_primal=np.array([1e-9, np.inf]),
+            res_dual=np.array([1e-9, 1e-2]),
+            gap=np.array([np.nan, np.nan]),
+        )
+        st = batch_stats(sol)
+        assert st["nonfinite_count"] == 4
+        assert st["iterations"]["max"] == 7
+        assert np.isnan(st["gap"]["median"])  # all-NaN field reported, not fatal
+
+
+class TestTraceSummaryTool:
+    def _synthetic_journal(self, path):
+        tr = Tracer(str(path), manifest_extra={"tool": "synthetic"})
+        with tr.span("sweep"):
+            with tr.span("point_0", h2=2.0):
+                sol, trc = solve_lp(_toy_lp(), max_iter=30, trace=True)
+                tr.solve_event("point_0", sol, trace=trc)
+        tr.close()
+
+    def test_smoke_on_synthetic_journal(self, tmp_path, capsys):
+        path = tmp_path / "synthetic.jsonl"
+        self._synthetic_journal(path)
+        ts = importlib.import_module("tools.trace_summary")
+        assert ts.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "sweep" in out and "point_0" in out
+        assert "retrace totals" in out
+
+    def test_missing_file_is_an_error(self, tmp_path):
+        ts = importlib.import_module("tools.trace_summary")
+        assert ts.main([str(tmp_path / "nope.jsonl")]) == 2
+
+    def test_cli_subprocess(self, tmp_path):
+        """The tool also runs as a script (the documented invocation)."""
+        import subprocess
+        import sys
+
+        path = tmp_path / "synthetic.jsonl"
+        self._synthetic_journal(path)
+        import tools.trace_summary as ts
+
+        proc = subprocess.run(
+            [sys.executable, ts.__file__, str(path), "--last"],
+            capture_output=True, text=True, timeout=120,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "sweep" in proc.stdout

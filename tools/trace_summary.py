@@ -1,0 +1,228 @@
+"""Terminal summary of a JSONL run journal (dispatches_tpu.obs).
+
+Usage::
+
+    python tools/trace_summary.py JOURNAL.jsonl [--last] [--max-spans N]
+
+A journal file may hold several runs (every run appends, starting with a
+manifest record). For each run this prints:
+
+- a header from the manifest: run id, git SHA, device kind/count, tool;
+- the span tree with wall-clock seconds, ok/FAIL, and the per-span
+  retrace deltas the Tracer recorded;
+- every solve record: batch size, converged fraction, the iteration
+  histogram `batch_stats` embedded at record time, and — when a
+  SolveTrace rode along — recorded-iteration range plus divergent-element
+  flags (`trace_stats`);
+- cumulative retrace counts from the close record (or summed span deltas
+  for a run that died before closing).
+
+`main(argv)` is importable so tests can smoke it in-process.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _read_journal(path: str) -> List[dict]:
+    # local JSONL reader (same torn-line policy as obs.journal.read_journal)
+    # so summarizing a journal never needs to import jax
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def _split_runs(events: List[dict]) -> List[List[dict]]:
+    """Split a multi-run journal at its manifest records. A leading
+    manifest-less fragment (torn file) is kept as its own run."""
+    runs: List[List[dict]] = []
+    cur: List[dict] = []
+    for ev in events:
+        if ev.get("kind") == "manifest" and cur:
+            runs.append(cur)
+            cur = []
+        cur.append(ev)
+    if cur:
+        runs.append(cur)
+    return runs
+
+
+def _fmt_retraces(delta: dict) -> str:
+    if not delta:
+        return ""
+    inner = ", ".join(f"{k}+{v}" for k, v in sorted(delta.items()))
+    return f"  retraces[{inner}]"
+
+
+def _fmt_hist(hist: dict) -> str:
+    return " ".join(f"{k}:{v}" for k, v in hist.items())
+
+
+def _print_spans(run: List[dict], out, max_spans: int) -> None:
+    ends = [e for e in run if e.get("kind") == "span_end"]
+    if not ends:
+        print("  (no spans)", file=out)
+        return
+    # start order gives the tree order; ends are matched FIFO per path so
+    # repeated span names (retried stages) each get their own row
+    starts = [e for e in run if e.get("kind") == "span_start"]
+    pending = list(ends)
+
+    def end_for(path: str) -> Optional[dict]:
+        for i, e in enumerate(pending):
+            if e.get("span") == path:
+                return pending.pop(i)
+        return None
+
+    shown = 0
+    for st in starts:
+        path = st.get("span", "")
+        depth = path.count("/")
+        en = end_for(path)
+        name = path.rsplit("/", 1)[-1]
+        if shown >= max_spans:
+            remaining = len(starts) - shown
+            print(f"  ... ({remaining} more spans; --max-spans to widen)",
+                  file=out)
+            return
+        shown += 1
+        if en is None:
+            print(f"  {'  ' * depth}{name:<32} (unclosed)", file=out)
+            continue
+        status = "ok" if en.get("ok") else "FAIL"
+        wall = en.get("wall_s", float("nan"))
+        mem = en.get("mem_watermark_bytes")
+        mem_txt = f"  mem={mem / 2**20:.0f}MiB" if mem else ""
+        print(
+            f"  {'  ' * depth}{name:<32}{wall:>9.3f}s  {status}"
+            f"{_fmt_retraces(en.get('retraces', {}))}{mem_txt}",
+            file=out,
+        )
+
+
+def _print_solves(run: List[dict], out) -> None:
+    solves = [e for e in run if e.get("kind") == "solve"]
+    if not solves:
+        return
+    print("  solves:", file=out)
+    for ev in solves:
+        name = ev.get("name", "?")
+        stats = ev.get("stats")
+        if not isinstance(stats, dict):
+            err = ev.get("stats_error", "no stats")
+            print(f"    {name}: ({err})", file=out)
+            continue
+        it = stats.get("iterations", {})
+        line = (
+            f"    {name}: batch={stats.get('batch')} "
+            f"converged={stats.get('converged_frac', float('nan')):.3f} "
+            f"iters[{it.get('min')}..{it.get('max')} "
+            f"med {it.get('median')}]"
+        )
+        if stats.get("nonfinite_count"):
+            line += f" nonfinite={stats['nonfinite_count']}"
+        print(line, file=out)
+        if it.get("hist"):
+            print(f"      hist: {_fmt_hist(it['hist'])}", file=out)
+        tr = ev.get("trace")
+        if isinstance(tr, dict):
+            rec = tr.get("recorded_iterations", [])
+            nd = tr.get("n_divergent", 0)
+            flag = f"  DIVERGENT x{nd}" if nd else ""
+            rng = f"{min(rec)}..{max(rec)}" if rec else "none"
+            print(f"      trace: recorded iters {rng}{flag}", file=out)
+
+
+def _print_run(run: List[dict], out, max_spans: int) -> None:
+    man = next((e for e in run if e.get("kind") == "manifest"), {})
+    sha = (man.get("git_sha") or "?")[:12]
+    dev = man.get("device_kind") or man.get("platform") or "no-backend"
+    n_dev = man.get("device_count")
+    dev_txt = f"{dev} x{n_dev}" if n_dev else str(dev)
+    tool = man.get("tool") or man.get("cmd") or ""
+    print(
+        f"run {man.get('run_id', '?')}  git {sha}  device {dev_txt}"
+        + (f"  [{tool}]" if tool else ""),
+        file=out,
+    )
+    _print_spans(run, out, max_spans)
+    _print_solves(run, out)
+    close = next((e for e in run if e.get("kind") == "close"), None)
+    if close is not None:
+        totals = close.get("retrace_totals", {})
+        if totals:
+            txt = ", ".join(f"{k}: {v}" for k, v in sorted(totals.items()))
+            print(f"  retrace totals: {txt}", file=out)
+    else:
+        # no close record — the run died; sum span deltas as best effort
+        totals: dict = {}
+        for e in run:
+            if e.get("kind") == "span_end":
+                for k, v in (e.get("retraces") or {}).items():
+                    totals[k] = totals.get(k, 0) + v
+        extra = ", ".join(f"{k}: {v}" for k, v in sorted(totals.items()))
+        print(
+            "  (run not closed — killed or still live)"
+            + (f"  span retraces: {extra}" if extra else ""),
+            file=out,
+        )
+    events = [e for e in run if e.get("kind") == "event"]
+    fails = [e for e in events if e.get("name") in
+             ("attempt_failed", "gate_failed", "bench_failed")]
+    if fails:
+        print(f"  failures: {len(fails)} "
+              f"({', '.join(e['name'] for e in fails[:6])}"
+              f"{', ...' if len(fails) > 6 else ''})", file=out)
+
+
+def main(argv=None, out=None) -> int:
+    out = out or sys.stdout
+    ap = argparse.ArgumentParser(
+        prog="trace_summary", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("journal", help="path to a JSONL run journal")
+    ap.add_argument(
+        "--last", action="store_true",
+        help="summarize only the most recent run in the file",
+    )
+    ap.add_argument(
+        "--max-spans", type=int, default=60,
+        help="cap on span rows printed per run (default 60)",
+    )
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.journal):
+        print(f"trace_summary: no such file: {args.journal}", file=sys.stderr)
+        return 2
+    events = _read_journal(args.journal)
+    if not events:
+        print(f"trace_summary: {args.journal} holds no parseable records",
+              file=sys.stderr)
+        return 2
+    runs = _split_runs(events)
+    if args.last:
+        runs = runs[-1:]
+    for i, run in enumerate(runs):
+        if i:
+            print(file=out)
+        _print_run(run, out, args.max_spans)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
