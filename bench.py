@@ -79,6 +79,20 @@ _T_START = time.perf_counter()
 _SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 _FORCE_CPU = os.environ.get("BENCH_FORCE_CPU") == "1"
 _OFF_RECORD = _SMOKE or _FORCE_CPU
+# BENCH_COST=1 attaches XLA cost-model records (obs.cost: FLOPs, bytes,
+# peak memory + roofline) to the year rows. Opt-in: the cost probe
+# compiles the solver a second time outside the jit call cache.
+_COST = os.environ.get("BENCH_COST") == "1"
+# --profile-dir DIR (or BENCH_PROFILE_DIR): capture a jax.profiler trace
+# of the bench run; journal span names become profiler TraceAnnotations.
+# Parsed here, *entered* inside main() after the platform is pinned —
+# starting the profiler earlier could initialize a backend first.
+_PROFILE_DIR = os.environ.get("BENCH_PROFILE_DIR")
+if "--profile-dir" in sys.argv:
+    _pd_i = sys.argv.index("--profile-dir")
+    if _pd_i + 1 < len(sys.argv):
+        _PROFILE_DIR = sys.argv[_pd_i + 1]
+_PROFILE_CM = None
 _LOCAL_PATH = os.path.join(
     REPO, "BENCH_SMOKE_LOCAL.json" if _OFF_RECORD else "BENCH_LOCAL.json"
 )
@@ -418,6 +432,15 @@ def _year_batch_child(npz_path, By):
         "iterations": [int(v) for v in np.asarray(sol2.iterations)],
         "scales_used": [float(v) for v in scales2],
     }
+    if _COST:
+        try:
+            from dispatches_tpu.obs import cost as obs_cost
+
+            out["cost"] = obs_cost.with_roofline(
+                obs_cost.lp_banded_batch_cost(meta, blp2, **kw), dt
+            )
+        except Exception as e:  # accounting must never fail the child
+            out["cost"] = {"error": f"{type(e).__name__}: {e}"}
     # atomic: the parent treats this file's existence as proof of a
     # delivered result, so a kill mid-write must not leave truncated JSON
     _atomic_dump(out, npz_path + ".out.json")
@@ -530,6 +553,12 @@ def main():
     if _FORCE_CPU:
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+    global _PROFILE_CM
+    if _PROFILE_DIR and _PROFILE_CM is None:
+        from dispatches_tpu.obs import profile_capture
+
+        _PROFILE_CM = profile_capture(_PROFILE_DIR)
+        _PROFILE_CM.__enter__()  # closed in the __main__ finally
     from dispatches_tpu.case_studies.renewables import params as P
     from dispatches_tpu.case_studies.renewables.pricetaker import (
         HybridDesign,
@@ -796,6 +825,17 @@ def main():
     yok = yconv and yerr < 5e-2
     _LOCAL["rows"]["year_single"]["rel_err_vs_highs"] = yerr
     _LOCAL["rows"]["year_single"]["gate_ok"] = yok
+    if _COST:
+        try:
+            from dispatches_tpu.obs import cost as obs_cost
+
+            yblp_c = ymeta.instantiate(yparams, dtype=jnp.float32)
+            ycost = obs_cost.with_roofline(
+                obs_cost.lp_banded_cost(ymeta, yblp_c, **ykw), ydt
+            )
+        except Exception as e:  # accounting must never fail the bench
+            ycost = {"error": f"{type(e).__name__}: {e}"}
+        _LOCAL["rows"]["year_single"]["cost"] = ycost
     _flush_local()
     _journal().event("row", name="year_single", **_LOCAL["rows"]["year_single"])
 
@@ -892,5 +932,8 @@ if __name__ == "__main__":
         try:
             main()
         finally:
+            if _PROFILE_CM is not None:
+                _PROFILE_CM.__exit__(None, None, None)
+                _PROFILE_CM = None
             if _TRACER is not None:
                 _TRACER.close()

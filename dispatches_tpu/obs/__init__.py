@@ -9,7 +9,26 @@ Three pillars (see docs/observability.md):
 3. **Compile & memory accounting** (`obs.retrace`, `obs.memory`): jit
    cache-miss counters per function signature and best-effort device
    memory watermarks.
+4. **Metrics registry** (`obs.metrics`): process-wide labeled
+   counters/gauges/histograms with Prometheus exposition; span-end
+   deltas and close-time snapshots flush into the journal.
+5. **XLA cost model** (`obs.cost`): static per-compiled-solver FLOPs /
+   bytes / peak-memory accounting plus roofline utilization against the
+   measured matmul peak.
+6. **Profiler capture** (`obs.profile`): opt-in `jax.profiler` traces
+   whose `TraceAnnotation`s mirror journal span names.
 """
+from .cost import (  # noqa: F401
+    chip_peak_tflops,
+    compiled_cost,
+    lp_banded_batch_cost,
+    lp_banded_cost,
+    lp_solve_cost,
+    nlp_solve_cost,
+    pdhg_solve_cost,
+    roofline,
+    with_roofline,
+)
 from .journal import (  # noqa: F401
     NullTracer,
     Tracer,
@@ -20,6 +39,23 @@ from .journal import (  # noqa: F401
     use_tracer,
 )
 from .memory import device_memory_stats, memory_watermark_bytes  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    counter_delta,
+    get_registry,
+    inc,
+    observe,
+    render_prometheus,
+    reset_metrics,
+    set_gauge,
+    snapshot,
+)
+from .profile import (  # noqa: F401
+    annotation,
+    profile_capture,
+    profiler_available,
+    profiling_active,
+)
 from .retrace import (  # noqa: F401
     note_trace,
     reset_retrace_counts,
@@ -59,4 +95,26 @@ __all__ = [
     "signature_of",
     "device_memory_stats",
     "memory_watermark_bytes",
+    "MetricsRegistry",
+    "get_registry",
+    "inc",
+    "set_gauge",
+    "observe",
+    "snapshot",
+    "render_prometheus",
+    "reset_metrics",
+    "counter_delta",
+    "compiled_cost",
+    "lp_solve_cost",
+    "lp_banded_cost",
+    "lp_banded_batch_cost",
+    "nlp_solve_cost",
+    "pdhg_solve_cost",
+    "chip_peak_tflops",
+    "roofline",
+    "with_roofline",
+    "annotation",
+    "profile_capture",
+    "profiler_available",
+    "profiling_active",
 ]

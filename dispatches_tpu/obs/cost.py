@@ -1,0 +1,230 @@
+"""Static XLA cost-model accounting (observability pillar 5).
+
+Wall-clock tells you what a solve *did* cost; the XLA cost model tells you
+what the compiled executable *should* cost — FLOPs, bytes accessed, peak
+temp memory — before it ever runs. Dividing model FLOPs by measured
+wall-clock against the chip's measured matmul peak
+(`tools/measure_matmul_peak.py` → `MATMUL_PEAK.json`) turns every journal
+solve record into a roofline point: are we compute-bound, memory-bound, or
+just leaving the MXU idle?
+
+`compiled_cost(jitted, *args)` goes through
+``jitted.lower(*args).compile().cost_analysis() / .memory_analysis()``.
+
+Two caveats, both load-bearing:
+
+- **`lower().compile()` does not populate the jit call cache**, so cost
+  accounting compiles the solver a second time. It is therefore strictly
+  opt-in at the call sites that wire it into journals (workflow
+  ``--cost``, bench ``BENCH_COST=1``) — never ambient in a sweep loop.
+- Backends differ in what they report (some return no cost analysis, some
+  no memory stats). Every extractor is best-effort: missing pieces land
+  as ``*_error`` strings in the record instead of raising, so a cost
+  probe can never kill the run it is measuring.
+
+Per-solver helpers (`lp_solve_cost`, `lp_banded_cost`,
+`lp_banded_batch_cost`, `nlp_solve_cost`, `pdhg_solve_cost`) exist because
+two of the four entry points are plain Python wrappers over an inner jit —
+the helper re-wraps them with their static arguments closed over so
+`.lower` exists.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# cost_analysis() key -> journal record key
+_COST_KEYS = {
+    "flops": "flops",
+    "bytes accessed": "bytes_accessed",
+    "transcendentals": "transcendentals",
+}
+
+# CompiledMemoryStats attr -> journal record key
+_MEM_KEYS = {
+    "temp_size_in_bytes": "temp_bytes",
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+}
+
+
+def cost_from_compiled(compiled: Any) -> Dict[str, Any]:
+    """Extract the cost/memory record from an already-`compile()`d
+    executable (jax returns `cost_analysis` as a one-element list on
+    current versions and a bare dict on older ones; both are handled)."""
+    rec: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            for src, dst in _COST_KEYS.items():
+                if src in ca:
+                    rec[dst] = float(ca[src])
+    except Exception as e:
+        rec["cost_analysis_error"] = f"{type(e).__name__}: {e}"
+    try:
+        ma = compiled.memory_analysis()
+        for src, dst in _MEM_KEYS.items():
+            v = getattr(ma, src, None)
+            if v is not None:
+                rec[dst] = int(v)
+        if "temp_bytes" in rec:
+            # the device-resident high-water mark of one execution:
+            # everything live at once, minus donated/aliased input space
+            rec["peak_bytes"] = (
+                rec.get("argument_bytes", 0)
+                + rec.get("output_bytes", 0)
+                + rec["temp_bytes"]
+                - rec.get("alias_bytes", 0)
+            )
+    except Exception as e:
+        rec["memory_analysis_error"] = f"{type(e).__name__}: {e}"
+    return rec
+
+
+def compiled_cost(jitted: Any, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+    """Lower + compile `jitted` for these arguments and return its static
+    cost record: flops / bytes_accessed / transcendentals from
+    `cost_analysis()`, and temp/argument/output/alias/peak bytes from
+    `memory_analysis()`. Compiles outside the jit call cache — see module
+    docstring; keep this opt-in."""
+    lowered = jitted.lower(*args, **kwargs)
+    return cost_from_compiled(lowered.compile())
+
+
+# -- roofline ----------------------------------------------------------
+
+
+def chip_peak_tflops(repo_root: Optional[str] = None) -> Tuple[Optional[float], str]:
+    """The roofline denominator: measured f32 matmul peak when
+    `MATMUL_PEAK.json` exists (written by `tools/measure_matmul_peak.py`
+    on the real chip), else the assumed spec number recorded in
+    `BASELINE_HOST.json` `chip_mfu.peak_f32_tflops`, else None. Returns
+    ``(tflops, source)``."""
+    root = repo_root or _REPO_ROOT
+    try:
+        with open(os.path.join(root, "MATMUL_PEAK.json"), "r") as f:
+            peak = json.load(f).get("achieved_f32_tflops")
+        if peak:
+            return float(peak), "MATMUL_PEAK.json (measured)"
+    except Exception:
+        pass
+    try:
+        with open(os.path.join(root, "BASELINE_HOST.json"), "r") as f:
+            peak = (json.load(f).get("chip_mfu") or {}).get("peak_f32_tflops")
+        if peak:
+            return float(peak), "BASELINE_HOST.json chip_mfu (assumed)"
+    except Exception:
+        pass
+    return None, "unavailable"
+
+
+def roofline(
+    flops: Optional[float],
+    wall_s: Optional[float],
+    peak_tflops: Optional[float] = None,
+    repo_root: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Roofline-utilization estimate: model FLOPs / measured wall-clock,
+    as a fraction of the chip's matmul peak. NaN-safe — returns a record
+    with whatever could be computed (an ``achieved_tflops`` without a
+    ``utilization`` when no peak anchor exists)."""
+    rec: Dict[str, Any] = {}
+    source = None
+    if peak_tflops is None:
+        peak_tflops, source = chip_peak_tflops(repo_root)
+    if peak_tflops is not None:
+        rec["peak_tflops"] = float(peak_tflops)
+        if source:
+            rec["peak_source"] = source
+    if flops is not None and wall_s is not None and wall_s > 0:
+        achieved = float(flops) / float(wall_s) / 1e12
+        rec["achieved_tflops"] = achieved
+        if peak_tflops:
+            rec["utilization"] = achieved / float(peak_tflops)
+    return rec
+
+
+def with_roofline(cost: Dict[str, Any], wall_s: Optional[float]) -> Dict[str, Any]:
+    """Return `cost` with a ``roofline`` sub-record derived from its
+    ``flops`` and the measured `wall_s` (no-op copy when either side is
+    missing)."""
+    out = dict(cost)
+    rl = roofline(out.get("flops"), wall_s)
+    if rl:
+        out["roofline"] = rl
+    return out
+
+
+# -- per-solver entry points -------------------------------------------
+# Each returns the compiled-cost record for one solver configuration,
+# tagged with the solver name. Jitted entry points lower directly; the
+# banded wrappers (plain Python over an inner jit with static meta) are
+# re-jitted with everything static closed over.
+
+
+def lp_solve_cost(lp: Any, **solver_kw: Any) -> Dict[str, Any]:
+    """Cost record for the dense IPM `solve_lp` on this LP + config."""
+    from ..solvers.ipm import solve_lp
+
+    rec = compiled_cost(solve_lp, lp, **solver_kw)
+    rec["solver"] = "solve_lp"
+    return rec
+
+
+def lp_banded_cost(meta: Any, blp: Any, **solver_kw: Any) -> Dict[str, Any]:
+    """Cost record for the banded SPIKE IPM `solve_lp_banded`."""
+    import jax
+
+    from ..solvers.structured import solve_lp_banded
+
+    jitted = jax.jit(lambda b: solve_lp_banded(meta, b, **solver_kw))
+    rec = compiled_cost(jitted, blp)
+    rec["solver"] = "solve_lp_banded"
+    return rec
+
+
+def lp_banded_batch_cost(
+    meta: Any, blp: Any, sharding: Any = None, **solver_kw: Any
+) -> Dict[str, Any]:
+    """Cost record for the scenario-batched `solve_lp_banded_batch`
+    (FLOPs scale with the batch axis; divide by batch for per-scenario)."""
+    import jax
+
+    from ..solvers.structured import solve_lp_banded_batch
+
+    jitted = jax.jit(
+        lambda b: solve_lp_banded_batch(meta, b, sharding=sharding, **solver_kw)
+    )
+    rec = compiled_cost(jitted, blp)
+    rec["solver"] = "solve_lp_banded_batch"
+    return rec
+
+
+def nlp_solve_cost(
+    f_obj: Any, c_eq: Any, x0: Any, l: Any, u: Any, params: Any = None,
+    **solver_kw: Any,
+) -> Dict[str, Any]:
+    """Cost record for the barrier NLP `solve_nlp` on this problem."""
+    from ..solvers.nlp import solve_nlp
+
+    rec = compiled_cost(solve_nlp, f_obj, c_eq, x0, l, u, params, **solver_kw)
+    rec["solver"] = "solve_nlp"
+    return rec
+
+
+def pdhg_solve_cost(lp: Any, **solver_kw: Any) -> Dict[str, Any]:
+    """Cost record for the first-order `solve_lp_pdhg` on this SparseLP."""
+    from ..solvers.pdhg import solve_lp_pdhg
+
+    rec = compiled_cost(solve_lp_pdhg, lp, **solver_kw)
+    rec["solver"] = "solve_lp_pdhg"
+    return rec

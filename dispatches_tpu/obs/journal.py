@@ -35,13 +35,19 @@ import sys
 import threading
 import time
 import uuid
+import warnings
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 from . import memory as _memory
+from . import metrics as _metrics
 from . import retrace as _retrace
 
-_SCHEMA_VERSION = 1
+# v1: PR 1 (manifest/span/solve/close records).
+# v2: manifest gains "schema_version" + "clock"; span_start/span_end carry
+#     monotonic "mono" stamps; span_end gains "metrics" (counter deltas);
+#     solve records gain optional "cost"; close gains "metrics" snapshot.
+_SCHEMA_VERSION = 2
 
 
 def _git_sha() -> Optional[str]:
@@ -121,6 +127,9 @@ def build_manifest(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     m: Dict[str, Any] = {
         "kind": "manifest",
         "schema": _SCHEMA_VERSION,
+        "schema_version": _SCHEMA_VERSION,
+        # span durations come from time.perf_counter(), never wall-clock
+        "clock": "perf_counter",
         "ts": time.time(),
         "run_id": uuid.uuid4().hex[:12],
         "git_sha": _git_sha(),
@@ -172,31 +181,46 @@ class Tracer:
     @contextmanager
     def span(self, name: str, **attrs: Any):
         """Nested span context. Emits `span_start` and `span_end` records;
-        the end record carries wall_s, per-function retrace deltas seen
-        inside the span, and a device-memory watermark when available."""
+        the end record carries wall_s (monotonic-clock duration), the
+        per-function retrace deltas and metrics-counter deltas seen inside
+        the span, and a device-memory watermark when available. When a
+        profiler capture is active (`obs.profile.profile_capture`), the
+        span body runs under a `TraceAnnotation` with the span path, so
+        XLA traces and journal spans line up by name."""
+        from . import profile as _profile
+
         with self._lock:
             path = self._span_path(name)
             self._stack.append(name)
-        self._emit({"kind": "span_start", "ts": time.time(), "span": path, **attrs})
-        before = _retrace.retrace_counts()
         t0 = time.perf_counter()
+        self._emit(
+            {"kind": "span_start", "ts": time.time(), "mono": t0, "span": path, **attrs}
+        )
+        before = _retrace.retrace_counts()
+        m_before = _metrics.flat_values()
         ok = True
+        ann = _profile.annotation(path)
         try:
-            yield self
+            with ann:
+                yield self
         except BaseException:
             ok = False
             raise
         finally:
-            wall = time.perf_counter() - t0
+            t1 = time.perf_counter()
             delta = _retrace.retrace_delta(before, _retrace.retrace_counts())
             rec = {
                 "kind": "span_end",
                 "ts": time.time(),
+                "mono": t1,
                 "span": path,
-                "wall_s": wall,
+                "wall_s": t1 - t0,
                 "ok": ok,
                 "retraces": delta,
             }
+            m_delta = _metrics.counter_delta(m_before, _metrics.flat_values())
+            if m_delta:
+                rec["metrics"] = m_delta
             wm = _memory.memory_watermark_bytes()
             if wm is not None:
                 rec["mem_watermark_bytes"] = wm
@@ -228,9 +252,14 @@ class Tracer:
             }
         )
 
-    def solve_event(self, name: str, sol: Any, trace: Any = None, **attrs: Any) -> None:
+    def solve_event(
+        self, name: str, sol: Any, trace: Any = None, cost: Any = None, **attrs: Any
+    ) -> None:
         """Record a solve result: `batch_stats` summary of `sol` plus, when
-        a `SolveTrace` is supplied, its host-side trajectory stats."""
+        a `SolveTrace` is supplied, its host-side trajectory stats, and,
+        when an `obs.cost` record is supplied, the XLA cost-model numbers
+        (flops / bytes accessed / peak temp memory) for the compiled
+        executable that produced `sol`."""
         rec: Dict[str, Any] = {
             "kind": "solve",
             "ts": time.time(),
@@ -238,6 +267,8 @@ class Tracer:
             "span": "/".join(self._stack) or None,
             **attrs,
         }
+        if cost is not None:
+            rec["cost"] = dict(cost) if isinstance(cost, dict) else cost
         try:
             from ..runtime.telemetry import batch_stats
 
@@ -254,18 +285,20 @@ class Tracer:
         self._emit(rec)
 
     def close(self) -> None:
-        """Emit a final record with cumulative retrace counts and close the
-        file. Idempotent."""
+        """Emit a final record with cumulative retrace counts and the full
+        metrics-registry snapshot, then close the file. Idempotent."""
         with self._lock:
             if self._fh is None and any(e.get("kind") == "close" for e in self.events):
                 return
-        self._emit(
-            {
-                "kind": "close",
-                "ts": time.time(),
-                "retrace_totals": _retrace.total_retraces(),
-            }
-        )
+        rec = {
+            "kind": "close",
+            "ts": time.time(),
+            "retrace_totals": _retrace.total_retraces(),
+        }
+        snap = _metrics.snapshot()
+        if any(snap.values()):
+            rec["metrics"] = snap
+        self._emit(rec)
         with self._lock:
             if self._fh is not None:
                 self._fh.close()
@@ -295,7 +328,9 @@ class NullTracer:
     def metric(self, name: str, value: Any, **attrs: Any) -> None:
         pass
 
-    def solve_event(self, name: str, sol: Any, trace: Any = None, **attrs: Any) -> None:
+    def solve_event(
+        self, name: str, sol: Any, trace: Any = None, cost: Any = None, **attrs: Any
+    ) -> None:
         pass
 
     def close(self) -> None:
@@ -336,18 +371,35 @@ def use_tracer(tracer):
 
 
 def read_journal(path: str) -> List[dict]:
-    """Parse a JSONL journal, skipping torn trailing lines (a killed run
-    may leave a partial final record)."""
+    """Parse a JSONL journal, skipping torn lines (a killed run may leave
+    a partial final record — including one that truncates to *valid*
+    non-dict JSON like ``42``, or tears mid-UTF-8-sequence). Journals from
+    a newer schema than this reader knows produce a warning, never an
+    exception: old tools must still render what they understand."""
     out: List[dict] = []
-    with open(path, "r", encoding="utf-8") as fh:
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
         for line in fh:
             line = line.strip()
             if not line:
                 continue
             try:
-                out.append(json.loads(line))
+                rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            if not isinstance(rec, dict):
+                continue
+            out.append(rec)
+    for rec in out:
+        if rec.get("kind") == "manifest":
+            ver = rec.get("schema_version", rec.get("schema"))
+            if isinstance(ver, (int, float)) and ver > _SCHEMA_VERSION:
+                warnings.warn(
+                    f"{path}: journal schema_version {ver} is newer than this "
+                    f"reader (knows <= {_SCHEMA_VERSION}); unknown record "
+                    "fields will be ignored",
+                    stacklevel=2,
+                )
+                break
     return out
 
 

@@ -1,0 +1,268 @@
+"""Process-wide metrics registry (observability pillar 4).
+
+PR 1's journal records are *events*; this module is the *aggregate*
+surface on top of them: labeled counters, gauges, and histograms in one
+thread-safe, process-global registry, replacing the ad-hoc per-caller
+dicts (`SolveTelemetry.summary()`, sweep-runner tallies) with a shared
+vocabulary any layer can increment and any exporter can read.
+
+Design rules, same as the rest of `obs`:
+
+- **Host-side only.** Metric calls take Python floats, never traced
+  values; nothing here may appear inside a jitted function body (except
+  via `note_trace`-style trace-time hooks, which belong to `obs.retrace`).
+  Solver outputs are bitwise identical with the registry active.
+- **Cheap when idle.** A counter bump is one lock + one dict add; an
+  unused registry costs nothing.
+- **Journal integration.** `Tracer.span(...)` snapshots the counter
+  surface at span entry and flushes the nonzero delta into the
+  `span_end` record automatically; `Tracer.close()` embeds the full
+  snapshot, so every journal carries the aggregate view of its own run.
+
+Series identity is ``(name, sorted labels)``; the JSON/snapshot key is the
+Prometheus-style ``name{k="v",...}`` string so journals and text
+exposition agree on naming.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+
+# Default histogram buckets: wall-clock-seconds flavored (the dominant
+# histogram use), spanning sub-ms host ops to multi-minute year solves.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0, 300.0,
+)
+
+_SeriesKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _series_key(name: str, labels: Mapping[str, Any]) -> _SeriesKey:
+    return (str(name), tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+
+
+def series_name(name: str, labels: Mapping[str, Any]) -> str:
+    """Prometheus-style series string, ``name{k="v",...}`` (bare ``name``
+    when unlabeled) — the snapshot/journal key format."""
+    if not labels:
+        return str(name)
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(
+        (str(k), str(v)) for k, v in labels.items()
+    ))
+    return f"{name}{{{inner}}}"
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +inf tail bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class MetricsRegistry:
+    """Thread-safe labeled counters / gauges / histograms.
+
+    One module-level instance (`get_registry()`) serves the process; fresh
+    instances are for tests. All mutators accept labels as keyword
+    arguments: ``reg.inc("solves_total", solver="solve_lp")``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[_SeriesKey, float] = {}
+        self._gauges: Dict[_SeriesKey, float] = {}
+        self._hists: Dict[_SeriesKey, _Histogram] = {}
+
+    # -- mutators ------------------------------------------------------
+    def inc(self, name: str, value: float = 1.0, **labels: Any) -> None:
+        """Add `value` (default 1) to a counter series."""
+        key = _series_key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + float(value)
+
+    def set_gauge(self, name: str, value: float, **labels: Any) -> None:
+        """Set a gauge series to `value` (last-write-wins)."""
+        key = _series_key(name, labels)
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        buckets: Optional[Sequence[float]] = None,
+        **labels: Any,
+    ) -> None:
+        """Record `value` into a histogram series. `buckets` applies only
+        on first observation of a series (upper bounds, ascending)."""
+        key = _series_key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Histogram(buckets or DEFAULT_BUCKETS)
+            h.observe(float(value))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    # -- readers -------------------------------------------------------
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Full registry state as plain JSON-safe dicts keyed by the
+        ``name{labels}`` series string."""
+        with self._lock:
+            return {
+                "counters": {
+                    series_name(n, dict(ls)): v
+                    for (n, ls), v in self._counters.items()
+                },
+                "gauges": {
+                    series_name(n, dict(ls)): v
+                    for (n, ls), v in self._gauges.items()
+                },
+                "histograms": {
+                    series_name(n, dict(ls)): {
+                        "count": h.count,
+                        "sum": h.sum,
+                        "buckets": {
+                            (str(b) if i < len(h.buckets) else "+Inf"): c
+                            for i, (b, c) in enumerate(
+                                zip(h.buckets + (float("inf"),), h.counts)
+                            )
+                        },
+                    }
+                    for (n, ls), h in self._hists.items()
+                },
+            }
+
+    def flat_values(self) -> Dict[str, float]:
+        """Monotone series as one flat {series: value} dict — counters plus
+        per-histogram ``_count``/``_sum`` — the delta basis for the
+        journal's span-end metrics flush (gauges are excluded: a gauge
+        delta over a span is not meaningful)."""
+        with self._lock:
+            out = {
+                series_name(n, dict(ls)): v
+                for (n, ls), v in self._counters.items()
+            }
+            for (n, ls), h in self._hists.items():
+                base = series_name(n, dict(ls))
+                out[base + "_count"] = float(h.count)
+                out[base + "_sum"] = h.sum
+            return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (0.0.4) of the whole registry."""
+        lines = []
+        snap = self.snapshot()
+        seen_type: Dict[str, str] = {}
+
+        def type_line(series: str, kind: str):
+            base = series.split("{", 1)[0]
+            if seen_type.get(base) != kind:
+                seen_type[base] = kind
+                lines.append(f"# TYPE {base} {kind}")
+
+        for series, v in sorted(snap["counters"].items()):
+            type_line(series, "counter")
+            lines.append(f"{series} {_fmt(v)}")
+        for series, v in sorted(snap["gauges"].items()):
+            type_line(series, "gauge")
+            lines.append(f"{series} {_fmt(v)}")
+        for series, h in sorted(snap["histograms"].items()):
+            type_line(series, "histogram")
+            name, labels = _split_series(series)
+            cum = 0
+            for b, c in h["buckets"].items():
+                cum += c
+                lines.append(
+                    f"{name}_bucket{_merge_labels(labels, le=b)} {cum}"
+                )
+            lines.append(f"{name}_sum{labels or ''} {_fmt(h['sum'])}")
+            lines.append(f"{name}_count{labels or ''} {h['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _split_series(series: str) -> Tuple[str, str]:
+    if "{" in series:
+        name, rest = series.split("{", 1)
+        return name, "{" + rest
+    return series, ""
+
+
+def _merge_labels(labels: str, **extra: str) -> str:
+    inner = labels[1:-1] if labels else ""
+    add = ",".join(f'{k}="{v}"' for k, v in extra.items())
+    inner = f"{inner},{add}" if inner else add
+    return "{" + inner + "}"
+
+
+def counter_delta(
+    before: Mapping[str, float], after: Mapping[str, float]
+) -> Dict[str, float]:
+    """Per-series increase between two `flat_values()` snapshots (nonzero
+    entries only; same contract as `retrace.retrace_delta`)."""
+    out: Dict[str, float] = {}
+    for series, v in after.items():
+        d = v - before.get(series, 0.0)
+        if d:
+            out[series] = d
+    return out
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry."""
+    return _REGISTRY
+
+
+# module-level conveniences bound to the process registry
+def inc(name: str, value: float = 1.0, **labels: Any) -> None:
+    _REGISTRY.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels: Any) -> None:
+    _REGISTRY.set_gauge(name, value, **labels)
+
+
+def observe(
+    name: str, value: float, buckets: Optional[Sequence[float]] = None,
+    **labels: Any,
+) -> None:
+    _REGISTRY.observe(name, value, buckets, **labels)
+
+
+def snapshot() -> Dict[str, Dict[str, Any]]:
+    return _REGISTRY.snapshot()
+
+
+def flat_values() -> Dict[str, float]:
+    return _REGISTRY.flat_values()
+
+
+def render_prometheus() -> str:
+    return _REGISTRY.render_prometheus()
+
+
+def reset_metrics() -> None:
+    _REGISTRY.reset()

@@ -1,0 +1,99 @@
+"""Opt-in `jax.profiler` capture (observability pillar 6).
+
+Journals say *when* a span ran and how long it took; an XLA profile says
+*what the chip did* inside it. This module bridges the two: an explicit
+capture context writes a TensorBoard-loadable trace (`.xplane.pb`), and
+`Tracer.span(...)` bodies run under a `jax.profiler.TraceAnnotation`
+carrying the journal span path — so the timeline in the profile and the
+span tree in the journal line up by name.
+
+Zero-overhead contract: with no capture active, `annotation(name)` is a
+shared no-op context manager — no jax import, no object churn, nothing in
+traced code. Capture is strictly opt-in (`--profile-dir` on the workflow
+CLI and bench.py), never ambient: profiling changes timings and writes
+large artifacts, so it must be a deliberate act.
+
+    from dispatches_tpu.obs.profile import profile_capture
+
+    with profile_capture("runs/profile"):
+        run_year_sweep(...)          # journal spans become TraceAnnotations
+
+`profile_capture(None)` is inert, so callers can pass the CLI flag value
+through unconditionally.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager, nullcontext
+from typing import Any, Iterator, Optional
+
+# Count of live captures (int, not bool: captures could in principle nest
+# across threads); annotation() is a no-op whenever this is zero.
+_ACTIVE = 0
+
+_NULL_CM = nullcontext()
+
+
+def profiling_active() -> bool:
+    """True while a `profile_capture` is open."""
+    return _ACTIVE > 0
+
+
+def profiler_available() -> bool:
+    """Can `jax.profiler` start a trace in this environment?"""
+    try:
+        import jax.profiler  # noqa: F401
+
+        return hasattr(jax.profiler, "start_trace")
+    except Exception:
+        return False
+
+
+def annotation(name: str):
+    """A `jax.profiler.TraceAnnotation(name)` while a capture is active,
+    else a shared no-op context manager. Safe to call unconditionally on
+    every journal span."""
+    if _ACTIVE <= 0:
+        return _NULL_CM
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(str(name))
+    except Exception:
+        return _NULL_CM
+
+
+@contextmanager
+def profile_capture(log_dir: Optional[str]) -> Iterator[Optional[str]]:
+    """Capture a `jax.profiler` trace into `log_dir` for the duration of
+    the block; yields the directory (or None when inert).
+
+    Inert — yielding None without touching jax — when `log_dir` is falsy
+    or the profiler is unavailable, so CLI plumbing can always wrap the
+    workload in this context and let the flag decide.
+    """
+    global _ACTIVE
+    if not log_dir or not profiler_available():
+        yield None
+        return
+    import jax.profiler
+
+    log_dir = str(log_dir)
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _ACTIVE += 1
+    try:
+        yield log_dir
+    finally:
+        _ACTIVE -= 1
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            # a capture that failed to finalize must not mask the
+            # workload's own exception
+            pass
+
+
+def annotate(name: str, **_ignored: Any):
+    """Alias of `annotation` for call sites that read better as a verb."""
+    return annotation(name)

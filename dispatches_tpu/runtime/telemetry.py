@@ -23,6 +23,12 @@ import numpy as np
 
 import jax
 
+from ..obs import metrics as _metrics
+
+# iteration-count flavored buckets (the wall-clock default buckets are
+# wrong for a quantity that lives in [1, max_iter])
+_ITER_BUCKETS = (1, 2, 5, 10, 20, 30, 45, 60, 80, 100, 150)
+
 
 @dataclasses.dataclass
 class SolveRecord:
@@ -63,11 +69,22 @@ class SolveTelemetry:
         result unchanged. Tolerates results that are not solution pytrees
         (tuples, None — recorded with NaN residuals rather than raising).
         When `fn` raises, a `failed=True` record with the exception type is
-        appended and the exception re-raised."""
+        appended and the exception re-raised.
+
+        Every observation also lands in the process metrics registry
+        (`obs.metrics`): `solves_total`/`solve_failures_total` counters,
+        `solve_batch_total`, and `solve_wall_seconds`/`solve_iterations`
+        histograms, all labeled `solve="<name>"` — so journals pick up the
+        aggregate via the span-end flush with no per-runner dict plumbing.
+        All host-side: `fn`'s compiled computation is untouched."""
         t0 = time.perf_counter()
         try:
             sol = fn(*args, **kwargs)
         except Exception as e:
+            wall = time.perf_counter() - t0
+            _metrics.inc("solve_failures_total", solve=name,
+                         error=type(e).__name__)
+            _metrics.observe("solve_wall_seconds", wall, solve=name)
             self.records.append(
                 SolveRecord(
                     name=name,
@@ -76,7 +93,7 @@ class SolveTelemetry:
                     res_primal=float("nan"),
                     res_dual=float("nan"),
                     gap=float("nan"),
-                    wall_s=time.perf_counter() - t0,
+                    wall_s=wall,
                     batch=0,
                     failed=True,
                     error=type(e).__name__,
@@ -91,10 +108,19 @@ class SolveTelemetry:
         conv = np.atleast_1d(np.asarray(getattr(sol, "converged", False)))
         iters = np.atleast_1d(np.asarray(getattr(sol, "iterations", 0)))
         it_fin = iters[np.isfinite(iters.astype(np.float64))]
+        max_iters = int(it_fin.max()) if it_fin.size else 0
+        _metrics.inc("solves_total", solve=name)
+        _metrics.inc("solve_batch_total", int(conv.size), solve=name)
+        if not bool(conv.all()):
+            _metrics.inc("solve_unconverged_total",
+                         int(conv.size - conv.sum()), solve=name)
+        _metrics.observe("solve_wall_seconds", wall, solve=name)
+        _metrics.observe("solve_iterations", max_iters,
+                         buckets=_ITER_BUCKETS, solve=name)
         self.records.append(
             SolveRecord(
                 name=name,
-                iterations=int(it_fin.max()) if it_fin.size else 0,
+                iterations=max_iters,
                 converged=bool(conv.all()),
                 res_primal=_field_max(sol, "res_primal"),
                 res_dual=_field_max(sol, "res_dual"),

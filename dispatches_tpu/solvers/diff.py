@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.program import LPData
+from ..obs import note_trace, signature_of
 from .ipm import IPMSolution, solve_lp
 
 
@@ -63,12 +64,16 @@ def _fwd(lp, tol, max_iter, refine_steps, bwd_reg):
         lp,
         is_leaf=lambda v: hasattr(v, "perturbed"),
     )
+    # counts trace-time entries: under jit/grad (the intended use) this is
+    # the forward rule's compilation-cache-miss count
+    note_trace("solve_lp_diff_fwd", signature_of(*lp))
     sol = solve_lp(lp, tol=tol, max_iter=max_iter, refine_steps=refine_steps)
     return sol, (lp, sol)
 
 
 def _bwd(tol, max_iter, refine_steps, bwd_reg, res, ct: IPMSolution):
     lp, sol = res
+    note_trace("solve_lp_diff_bwd", signature_of(*lp))
     A, b, c, l, u, c0 = lp
     dtype = A.dtype
     if bwd_reg is None:

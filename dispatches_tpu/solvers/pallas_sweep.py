@@ -52,6 +52,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs import note_trace, signature_of
+
 LANE = 128
 SUB = 8  # f32 sublane
 
@@ -92,6 +94,7 @@ def chain_sweep(RT, BT, AT, interpret=False):
     (kp multiple of 8, mp multiple of 128); use `sweep` for the
     pad/transpose/flip plumbing.
     """
+    note_trace("chain_sweep", signature_of(RT, BT, AT))
     D, S, kp, mp = RT.shape
     grid = (D, S)
     spec_r = pl.BlockSpec((1, 1, kp, mp), lambda d, s: (d, s, 0, 0))

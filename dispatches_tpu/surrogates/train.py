@@ -25,6 +25,8 @@ import numpy as np
 import flax.linen as nn
 import optax
 
+from ..obs import note_trace, signature_of
+
 
 class SurrogateMLP(nn.Module):
     hidden: Sequence[int]
@@ -119,6 +121,11 @@ def train_surrogate(
 
     @jax.jit
     def step(params, opt_state):
+        # a fresh `step` closure compiles per train_surrogate call by
+        # design (it closes over the data); what the counter must expose
+        # is retracing WITHIN one training loop (shape/dtype drift)
+        note_trace("surrogate_train_step", signature_of(Xs, Ys))
+
         def loss_fn(p):
             pred = model.apply(p, Xs)
             return jnp.mean((pred - Ys) ** 2)

@@ -24,6 +24,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..obs import get_tracer
+from ..obs import metrics as obs_metrics
 from ..runtime.native import ResultStore
 from .options import SimulationOptions
 
@@ -74,6 +75,7 @@ def run_pricetaker(
                 if verbose:
                     print(f"[{i}] h2=${h2}/kg: checkpointed, skipping")
                 tracer.event("skip_checkpointed", point=i, h2_price=h2)
+                obs_metrics.inc("sweep_points_skipped_total", runner="pricetaker")
                 continue
             with tracer.span(f"point_{i}", h2_price=h2):
                 if topology == "wind_battery":
@@ -99,6 +101,7 @@ def run_pricetaker(
                 "solver_stats": res.get("solver_stats", {}),
             }
             out.append(rec)
+            obs_metrics.inc("sweep_points_total", runner="pricetaker")
             tracer.event(
                 "point_result", point=i, h2_price=h2, NPV=rec["NPV"],
                 solver_stats=rec["solver_stats"],
@@ -153,6 +156,7 @@ def run_battery_ratio_sweep(
                 if verbose:
                     print(f"[{i}] ratio={ratio} dur={dur}h: checkpointed, skipping")
                 tracer.event("skip_checkpointed", point=i, ratio=ratio, duration=dur)
+                obs_metrics.inc("sweep_points_skipped_total", runner="battsweep")
                 continue
             with tracer.span(f"point_{i}", ratio=ratio, duration_hrs=dur):
                 res = wind_battery_optimize(
@@ -174,6 +178,10 @@ def run_battery_ratio_sweep(
                 "solver_stats": res.get("solver_stats", {}),
             }
             out.append(rec)
+            obs_metrics.inc("sweep_points_total", runner="battsweep")
+            if not rec["converged"]:
+                obs_metrics.inc("sweep_points_unconverged_total",
+                                runner="battsweep")
             tracer.event(
                 "point_result", point=i, ratio=ratio, duration_hrs=dur,
                 NPV=rec["NPV"], converged=rec["converged"],
@@ -207,6 +215,7 @@ def run_year_sweep(
     verbose: bool = True,
     tracer=None,
     trace: bool = False,
+    cost: bool = False,
 ):
     """Year-scale LMP-scenario design sweep — the BASELINE.md north-star
     workload as a user entry point: N full-year (8,760 h) wind+battery+PEM
@@ -228,7 +237,16 @@ def run_year_sweep(
 
     `trace=True` threads per-iteration `SolveTrace` recording through the
     batched banded solves; trajectory summaries land in the journal's
-    per-batch solve events (`tracer`, default the process tracer)."""
+    per-batch solve events (`tracer`, default the process tracer).
+
+    `cost=True` (CLI `--cost`) additionally attaches the XLA cost-model
+    record (FLOPs, bytes accessed, peak memory via `obs.cost`) plus a
+    per-batch roofline-utilization estimate to those solve events. The
+    cost probe compiles the batched solver a second time (outside the jit
+    call cache), so it runs once, on the first batch only — every later
+    batch reuses the static record with its own measured wall-clock."""
+    import time as _time
+
     import jax
     import jax.numpy as jnp
 
@@ -316,8 +334,12 @@ def run_year_sweep(
         k for k in range(scenarios)
         if not any(key in done for key in _keys(k))
     ]
-    if verbose and len(pending) < scenarios:
-        print(f"{scenarios - len(pending)} scenarios checkpointed, skipping")
+    if len(pending) < scenarios:
+        obs_metrics.inc("year_scenarios_skipped_total",
+                        scenarios - len(pending), runner="yearsweep")
+        if verbose:
+            print(f"{scenarios - len(pending)} scenarios checkpointed, skipping")
+    cost_rec = None  # filled on the first batch when cost=True
     with tracer.span(
         "year_sweep", scenarios=scenarios, batch=batch, hours=hours,
         dtype=str(jdtype),
@@ -337,11 +359,22 @@ def run_year_sweep(
                 blp_b = jax.vmap(
                     lambda lm: meta.instantiate({"lmp": lm, "wind_cf": cf}, dtype=jdtype)
                 )(lmps)
+                if cost and cost_rec is None:
+                    from ..obs import cost as obs_cost
+
+                    try:
+                        cost_rec = obs_cost.lp_banded_batch_cost(
+                            meta, blp_b, trace=trace, **solver_kw
+                        )
+                    except Exception as e:  # accounting must not kill the sweep
+                        cost_rec = {"error": f"{type(e).__name__}: {e}"}
+                t0 = _time.perf_counter()
                 solve_out = solve_lp_banded_batch(
                     meta, blp_b, trace=trace, **solver_kw
                 )
                 sol, sol_tr = solve_out if trace else (solve_out, None)
                 convs = np.asarray(sol.converged)[: len(todo)]
+                solve_wall = _time.perf_counter() - t0
                 npvs = np.asarray(
                     jax.vmap(
                         lambda x, lm: prog.eval_expr(
@@ -350,7 +383,20 @@ def run_year_sweep(
                     )(sol.x, lmps)
                 )[: len(todo)]
                 stats = batch_stats(sol)
-                tracer.solve_event("year_batch", sol, trace=sol_tr)
+                batch_cost = None
+                if cost_rec is not None:
+                    from ..obs import cost as obs_cost
+
+                    batch_cost = obs_cost.with_roofline(cost_rec, solve_wall)
+                obs_metrics.inc("year_scenarios_solved_total",
+                                int(convs.sum()), runner="yearsweep")
+                if len(todo) - int(convs.sum()):
+                    obs_metrics.inc("year_scenarios_unconverged_total",
+                                    len(todo) - int(convs.sum()),
+                                    runner="yearsweep")
+                tracer.solve_event(
+                    "year_batch", sol, trace=sol_tr, cost=batch_cost
+                )
             for j, k in enumerate(todo):
                 rec = {
                     "scenario": k,
@@ -461,6 +507,12 @@ def main(argv=None):
         help="append-only JSONL run journal (manifest + spans + solve "
         "events; read it with tools/trace_summary.py)",
     )
+    p.add_argument(
+        "--profile-dir", default=None, metavar="DIR",
+        help="capture a jax.profiler trace of the whole command into DIR "
+        "(TensorBoard-loadable); journal span names become profiler "
+        "TraceAnnotations",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pt = sub.add_parser("pricetaker", help="price-taker design sweep")
@@ -498,6 +550,11 @@ def main(argv=None):
                     help="store block factors as inverses (TPU sweep speed)")
     ys.add_argument("--out", default=None, help="ResultStore checkpoint path")
     ys.add_argument(
+        "--cost", action="store_true",
+        help="attach XLA cost-model FLOPs/bytes/memory + roofline records "
+        "to journal solve events (compiles the solver once more; obs.cost)",
+    )
+    ys.add_argument(
         "--platform", choices=("default", "cpu"), default="default",
         help="cpu: force the host backend (the ambient environment may "
         "otherwise register an accelerator plugin)",
@@ -522,42 +579,46 @@ def main(argv=None):
 
         tracer = Tracer(args.journal, manifest_extra={"cmd": args.cmd})
         set_tracer(tracer)
+    from ..obs import profile_capture
+
     try:
-        if args.cmd == "pricetaker":
-            run_pricetaker(
-                topology=args.topology,
-                hours=args.hours,
-                h2_prices=args.h2_price,
-                store_path=args.out,
-            )
-        elif args.cmd == "doubleloop":
-            opts = (
-                SimulationOptions.load(args.config)
-                if args.config
-                else SimulationOptions(num_days=args.days)
-            )
-            opts.num_days = args.days
-            run_double_loop(opts, out_csv=args.out)
-        elif args.cmd == "battsweep":
-            run_battery_ratio_sweep(
-                ratios=args.ratio,
-                durations=args.duration,
-                hours=args.hours,
-                store_path=args.out,
-            )
-        elif args.cmd == "yearsweep":
-            run_year_sweep(
-                scenarios=args.scenarios,
-                batch=args.batch,
-                hours=args.hours,
-                h2_price=args.h2_price,
-                seed=args.seed,
-                dtype=args.dtype,
-                mixed_precision=not args.no_mixed_precision,
-                correctors=args.correctors,
-                inv_factors=args.inv_factors,
-                store_path=args.out,
-            )
+        with profile_capture(args.profile_dir):
+            if args.cmd == "pricetaker":
+                run_pricetaker(
+                    topology=args.topology,
+                    hours=args.hours,
+                    h2_prices=args.h2_price,
+                    store_path=args.out,
+                )
+            elif args.cmd == "doubleloop":
+                opts = (
+                    SimulationOptions.load(args.config)
+                    if args.config
+                    else SimulationOptions(num_days=args.days)
+                )
+                opts.num_days = args.days
+                run_double_loop(opts, out_csv=args.out)
+            elif args.cmd == "battsweep":
+                run_battery_ratio_sweep(
+                    ratios=args.ratio,
+                    durations=args.duration,
+                    hours=args.hours,
+                    store_path=args.out,
+                )
+            elif args.cmd == "yearsweep":
+                run_year_sweep(
+                    scenarios=args.scenarios,
+                    batch=args.batch,
+                    hours=args.hours,
+                    h2_price=args.h2_price,
+                    seed=args.seed,
+                    dtype=args.dtype,
+                    mixed_precision=not args.no_mixed_precision,
+                    correctors=args.correctors,
+                    inv_factors=args.inv_factors,
+                    store_path=args.out,
+                    cost=args.cost,
+                )
     finally:
         if tracer is not None:
             from ..obs import set_tracer

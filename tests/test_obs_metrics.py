@@ -1,0 +1,491 @@
+"""Tests for the obs analysis layer (ISSUE 2): metrics registry
+(thread-safety, jit neutrality, journal flush), XLA cost-model smoke for
+all four solver entry points, roofline anchors, profiler capture, journal
+v2 hardening (schema_version, monotonic spans, torn-line tolerance), and
+the tools/journal_diff.py regression gate."""
+import importlib
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispatches_tpu.core.program import LPData, SparseLP
+from dispatches_tpu.obs import Tracer, read_journal, use_tracer
+from dispatches_tpu.obs import cost as obs_cost
+from dispatches_tpu.obs import profile as obs_profile
+from dispatches_tpu.obs.metrics import (
+    MetricsRegistry,
+    counter_delta,
+    get_registry,
+    reset_metrics,
+)
+from dispatches_tpu.solvers.ipm import solve_lp
+
+INF = jnp.inf
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _toy_lp(scale=1.0):
+    # min x1 + 2 x2  s.t. x1 + x2 = scale, x >= 0  ->  x = (scale, 0)
+    return LPData(
+        A=jnp.ones((1, 2)),
+        b=jnp.asarray([float(scale)]),
+        c=jnp.asarray([1.0, 2.0]),
+        l=jnp.zeros(2),
+        u=jnp.full(2, INF),
+        c0=jnp.asarray(0.0),
+    )
+
+
+class TestMetricsRegistry:
+    def test_counters_gauges_histograms(self):
+        reg = MetricsRegistry()
+        reg.inc("solves_total", solver="lp")
+        reg.inc("solves_total", 2.0, solver="lp")
+        reg.inc("solves_total", solver="nlp")
+        reg.set_gauge("batch", 8, runner="year")
+        reg.set_gauge("batch", 16, runner="year")  # last-write-wins
+        reg.observe("wall", 0.2)
+        reg.observe("wall", 7.0)
+        snap = reg.snapshot()
+        assert snap["counters"]['solves_total{solver="lp"}'] == 3.0
+        assert snap["counters"]['solves_total{solver="nlp"}'] == 1.0
+        assert snap["gauges"]['batch{runner="year"}'] == 16.0
+        h = snap["histograms"]["wall"]
+        assert h["count"] == 2 and h["sum"] == pytest.approx(7.2)
+        assert sum(h["buckets"].values()) == 2
+        # snapshot must be JSON-serializable as-is (journal close embeds it)
+        json.dumps(snap)
+
+    def test_thread_safety(self):
+        reg = MetricsRegistry()
+        N, M = 8, 500
+
+        def work():
+            for _ in range(M):
+                reg.inc("hits", worker="shared")
+                reg.observe("lat", 0.01)
+
+        threads = [threading.Thread(target=work) for _ in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = reg.snapshot()
+        assert snap["counters"]['hits{worker="shared"}'] == N * M
+        assert snap["histograms"]["lat"]["count"] == N * M
+
+    def test_counter_delta(self):
+        reg = MetricsRegistry()
+        reg.inc("a")
+        before = reg.flat_values()
+        reg.inc("a", 2)
+        reg.inc("b")
+        d = counter_delta(before, reg.flat_values())
+        assert d == {"a": 3.0 - 1.0, "b": 1.0}
+
+    def test_prometheus_rendering(self):
+        reg = MetricsRegistry()
+        reg.inc("requests_total", 3, route="/solve")
+        reg.set_gauge("temperature", 1.5)
+        reg.observe("wall", 0.3, buckets=(0.1, 1.0))
+        text = reg.render_prometheus()
+        assert '# TYPE requests_total counter' in text
+        assert 'requests_total{route="/solve"} 3' in text
+        assert "# TYPE temperature gauge" in text
+        assert "temperature 1.5" in text
+        # histogram buckets must be cumulative and end at +Inf
+        assert 'wall_bucket{le="0.1"} 0' in text
+        assert 'wall_bucket{le="1.0"} 1' in text
+        assert 'wall_bucket{le="+Inf"} 1' in text
+        assert "wall_sum 0.3" in text and "wall_count 1" in text
+
+    def test_reset(self):
+        reg = MetricsRegistry()
+        reg.inc("x")
+        reg.reset()
+        assert reg.flat_values() == {}
+
+    def test_registry_active_is_bitwise_neutral(self):
+        # acceptance criterion: all instrumentation is host-side — solver
+        # outputs are bitwise identical with the registry active and hot
+        lp = _toy_lp(1.3)
+        sol_plain = solve_lp(lp, max_iter=30)
+        from dispatches_tpu.runtime.telemetry import SolveTelemetry
+
+        reset_metrics()
+        tel = SolveTelemetry()
+        sol_metered = tel.observe("lp", solve_lp, lp, max_iter=30)
+        assert np.array_equal(np.asarray(sol_plain.x), np.asarray(sol_metered.x))
+        assert np.array_equal(np.asarray(sol_plain.y), np.asarray(sol_metered.y))
+        assert int(sol_plain.iterations) == int(sol_metered.iterations)
+        # and the observation did land in the process registry
+        flat = get_registry().flat_values()
+        assert flat['solves_total{solve="lp"}'] == 1.0
+        assert flat['solve_wall_seconds{solve="lp"}_count'] == 1.0
+        reset_metrics()
+
+    def test_telemetry_failure_counter(self):
+        from dispatches_tpu.runtime.telemetry import SolveTelemetry
+
+        reset_metrics()
+        tel = SolveTelemetry()
+
+        def boom():
+            raise ValueError("no")
+
+        with pytest.raises(ValueError):
+            tel.observe("bad", boom)
+        flat = get_registry().flat_values()
+        assert flat['solve_failures_total{error="ValueError",solve="bad"}'] == 1.0
+        reset_metrics()
+
+    def test_span_flush_and_close_snapshot(self):
+        reset_metrics()
+        tracer = Tracer(None)
+        with tracer.span("outer"):
+            get_registry().inc("inner_work_total")
+        tracer.close()
+        end = next(e for e in tracer.events if e["kind"] == "span_end")
+        assert end["metrics"] == {"inner_work_total": 1.0}
+        close = next(e for e in tracer.events if e["kind"] == "close")
+        assert close["metrics"]["counters"]["inner_work_total"] == 1.0
+        reset_metrics()
+
+
+class TestCostModel:
+    """cost_analysis smoke for all four solver entry points, each attached
+    to a journal solve record (the acceptance criterion)."""
+
+    def _assert_cost(self, rec, solver):
+        assert rec["solver"] == solver
+        assert rec.get("flops", 0) > 0, rec
+        assert rec.get("bytes_accessed", 0) > 0, rec
+        # memory_analysis is best-effort per backend; when present the
+        # peak must be positive
+        if "peak_bytes" in rec:
+            assert rec["peak_bytes"] > 0
+        tracer = Tracer(None)
+        tracer.solve_event("probe", None, cost=rec)
+        ev = next(e for e in tracer.events if e.get("kind") == "solve")
+        assert ev["cost"]["flops"] == rec["flops"]
+        json.dumps(ev["cost"])  # journal records must serialize
+
+    def test_lp_cost(self):
+        self._assert_cost(
+            obs_cost.lp_solve_cost(_toy_lp(), max_iter=20), "solve_lp"
+        )
+
+    def test_nlp_cost(self):
+        f = lambda x, p: (1 - x[0]) ** 2 + 100 * (x[1] - x[0] ** 2) ** 2
+        c = lambda x, p: jnp.zeros((0,))
+        rec = obs_cost.nlp_solve_cost(
+            f, c, jnp.array([-1.2, 1.0]), -INF, INF, max_iter=50
+        )
+        self._assert_cost(rec, "solve_nlp")
+
+    def test_pdhg_cost(self):
+        rng = np.random.default_rng(0)
+        m, n = 6, 12
+        A = rng.standard_normal((m, n))
+        rows, cols = np.nonzero(A)
+        lp = SparseLP(
+            rows=jnp.asarray(rows, jnp.int32),
+            cols=jnp.asarray(cols, jnp.int32),
+            vals=jnp.asarray(A[rows, cols]),
+            b=jnp.asarray(A @ rng.uniform(0.5, 1.5, n)),
+            c=jnp.asarray(rng.standard_normal(n)),
+            l=jnp.zeros(n),
+            u=jnp.full(n, 3.0),
+            c0=jnp.asarray(0.0),
+        )
+        rec = obs_cost.pdhg_solve_cost(lp, tol=1e-4, max_iter=1000)
+        self._assert_cost(rec, "solve_lp_pdhg")
+
+    def test_banded_and_batch_cost(self):
+        from dispatches_tpu.case_studies.renewables import params as P
+        from dispatches_tpu.case_studies.renewables.pricetaker import (
+            HybridDesign,
+            build_pricetaker,
+        )
+        from dispatches_tpu.solvers.structured import extract_time_structure
+
+        T = 48
+        data = P.load_rts303()
+        design = HybridDesign(
+            T=T, with_battery=True, with_pem=True, design_opt=True,
+            h2_price_per_kg=2.5, initial_soc_fixed=None,
+        )
+        prog, _ = build_pricetaker(design)
+        meta = extract_time_structure(prog, T, block_hours=12)
+        lmp = jnp.asarray(data["da_lmp"][:T])
+        cf = jnp.asarray(data["da_wind_cf"][:T])
+        blp = meta.instantiate({"lmp": lmp, "wind_cf": cf})
+        rec = obs_cost.lp_banded_cost(meta, blp, max_iter=30)
+        self._assert_cost(rec, "solve_lp_banded")
+
+        blp_b = jax.vmap(
+            lambda lm: meta.instantiate({"lmp": lm, "wind_cf": cf})
+        )(jnp.stack([lmp, 1.1 * lmp]))
+        rec_b = obs_cost.lp_banded_batch_cost(meta, blp_b, max_iter=30)
+        self._assert_cost(rec_b, "solve_lp_banded_batch")
+        # the batched executable must cost more than the single solve
+        assert rec_b["flops"] > rec["flops"]
+
+    def test_roofline(self):
+        rl = obs_cost.roofline(flops=1e12, wall_s=2.0, peak_tflops=50.0)
+        assert rl["achieved_tflops"] == pytest.approx(0.5)
+        assert rl["utilization"] == pytest.approx(0.01)
+        # with no anchor at all: achieved only, no utilization
+        rl2 = obs_cost.roofline(1e12, 2.0, repo_root="/nonexistent")
+        assert rl2["achieved_tflops"] == pytest.approx(0.5)
+        assert "utilization" not in rl2
+        # zero/None wall never divides
+        assert "achieved_tflops" not in obs_cost.roofline(1e12, 0.0, 50.0)
+        assert "achieved_tflops" not in obs_cost.roofline(None, 1.0, 50.0)
+
+    def test_chip_anchor_chain(self, tmp_path):
+        # measured MATMUL_PEAK.json beats the assumed BASELINE_HOST number
+        (tmp_path / "MATMUL_PEAK.json").write_text(
+            json.dumps({"achieved_f32_tflops": 42.5})
+        )
+        (tmp_path / "BASELINE_HOST.json").write_text(
+            json.dumps({"chip_mfu": {"peak_f32_tflops": 49.0}})
+        )
+        peak, src = obs_cost.chip_peak_tflops(str(tmp_path))
+        assert peak == 42.5 and "measured" in src
+        os.remove(tmp_path / "MATMUL_PEAK.json")
+        peak, src = obs_cost.chip_peak_tflops(str(tmp_path))
+        assert peak == 49.0 and "assumed" in src
+
+    def test_with_roofline(self):
+        out = obs_cost.with_roofline({"flops": 2e12}, 1.0)
+        assert out["roofline"]["achieved_tflops"] == pytest.approx(2.0)
+        # missing wall: the flops survive and no utilization is invented
+        out2 = obs_cost.with_roofline({"flops": 1.0}, None)
+        assert out2["flops"] == 1.0
+        assert "achieved_tflops" not in out2.get("roofline", {})
+
+
+class TestJournalV2:
+    def test_manifest_schema_version_and_mono(self):
+        tracer = Tracer(None)
+        assert tracer.manifest["schema_version"] == 2
+        assert tracer.manifest["clock"] == "perf_counter"
+        with tracer.span("a"):
+            pass
+        start = next(e for e in tracer.events if e["kind"] == "span_start")
+        end = next(e for e in tracer.events if e["kind"] == "span_end")
+        # monotonic stamps: duration equals the mono difference and can
+        # never be negative, no matter what the wall clock does
+        assert end["mono"] >= start["mono"]
+        assert end["wall_s"] == pytest.approx(end["mono"] - start["mono"])
+        assert end["wall_s"] >= 0.0
+
+    def test_read_journal_skips_non_dict_and_bad_utf8(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        tracer = Tracer(str(p))
+        tracer.close()
+        with open(p, "ab") as fh:
+            # three torn-tail shapes: valid non-dict JSON, invalid JSON,
+            # and a tear mid-UTF-8 sequence
+            fh.write(b"42\nnull\n")
+            fh.write(b'{"kind": "event", "name": "tr\xc3')
+        recs = read_journal(str(p))
+        assert [r["kind"] for r in recs] == ["manifest", "close"]
+
+    def test_read_journal_warns_on_future_schema(self, tmp_path):
+        p = tmp_path / "future.jsonl"
+        p.write_text(
+            json.dumps({"kind": "manifest", "schema_version": 99}) + "\n"
+        )
+        with pytest.warns(UserWarning, match="schema_version 99"):
+            recs = read_journal(str(p))
+        assert len(recs) == 1  # warned, still parsed
+
+
+class TestProfileCapture:
+    def test_annotation_is_noop_when_idle(self):
+        assert not obs_profile.profiling_active()
+        cm = obs_profile.annotation("span/x")
+        # the shared null context manager: no profiler, no object churn
+        assert cm is obs_profile.annotation("span/y")
+        with cm:
+            pass
+
+    def test_capture_none_is_inert(self):
+        with obs_profile.profile_capture(None) as d:
+            assert d is None
+        assert not obs_profile.profiling_active()
+
+    def test_capture_smoke(self, tmp_path):
+        if not obs_profile.profiler_available():
+            pytest.skip("jax.profiler unavailable")
+        target = str(tmp_path / "prof")
+        try:
+            with obs_profile.profile_capture(target) as d:
+                assert d == target
+                assert obs_profile.profiling_active()
+                with obs_profile.annotation("tests/smoke"):
+                    jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+        except Exception as e:  # pragma: no cover - backend-specific
+            pytest.skip(f"profiler capture unsupported here: {e}")
+        assert not obs_profile.profiling_active()
+        captured = [
+            f for root, _, files in os.walk(target) for f in files
+        ]
+        assert any(f.endswith(".xplane.pb") for f in captured), captured
+
+    def test_journal_span_annotates_under_capture(self, tmp_path):
+        if not obs_profile.profiler_available():
+            pytest.skip("jax.profiler unavailable")
+        tracer = Tracer(None)
+        try:
+            with obs_profile.profile_capture(str(tmp_path / "p")):
+                with tracer.span("annotated"):
+                    pass
+        except Exception as e:  # pragma: no cover - backend-specific
+            pytest.skip(f"profiler capture unsupported here: {e}")
+        end = next(e for e in tracer.events if e["kind"] == "span_end")
+        assert end["ok"]
+
+
+class TestJournalDiff:
+    def _tool(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            return importlib.import_module("journal_diff")
+        finally:
+            sys.path.pop(0)
+
+    def _write_journal(self, path, wall_s, flops, retraces=2):
+        recs = [
+            {"kind": "manifest", "schema_version": 2, "run_id": "x"},
+            {"kind": "span_start", "span": "year_sweep", "mono": 0.0},
+            {
+                "kind": "span_end",
+                "span": "year_sweep",
+                "wall_s": wall_s,
+                "ok": True,
+                "retraces": {"solve_lp_banded": {"sig": retraces}},
+            },
+            {
+                "kind": "solve",
+                "name": "year_batch",
+                "stats": {"batch": 8, "converged_frac": 1.0,
+                          "iterations": {"median": 40.0, "max": 45}},
+                "cost": {"flops": flops, "bytes_accessed": 2 * flops,
+                         "peak_bytes": 1000, "solver": "solve_lp_banded_batch"},
+            },
+            {"kind": "close",
+             "retrace_totals": {"solve_lp_banded": retraces}},
+        ]
+        with open(path, "w") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+
+    def test_identical_runs_exit_zero(self, tmp_path):
+        jd = self._tool()
+        a = str(tmp_path / "a.jsonl")
+        self._write_journal(a, 10.0, 1e12)
+        assert jd.main([a, a]) == 0
+
+    def test_wallclock_regression_exits_nonzero(self, tmp_path):
+        jd = self._tool()
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        self._write_journal(a, wall_s=10.0, flops=1e12)
+        self._write_journal(b, wall_s=11.5, flops=1e12)  # +15% > 10%
+        assert jd.main([a, b]) == 1
+        # and the other direction (a speedup) passes
+        assert jd.main([b, a]) == 0
+
+    def test_flops_regression_exits_nonzero(self, tmp_path):
+        jd = self._tool()
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        self._write_journal(a, wall_s=10.0, flops=1e12)
+        self._write_journal(b, wall_s=10.0, flops=1.2e12)
+        assert jd.main([a, b]) == 1
+
+    def test_within_threshold_passes(self, tmp_path):
+        jd = self._tool()
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        self._write_journal(a, wall_s=10.0, flops=1e12)
+        self._write_journal(b, wall_s=10.5, flops=1.05e12)  # 5% < 10%
+        assert jd.main([a, b]) == 0
+
+    def test_threshold_override_and_retrace_growth(self, tmp_path):
+        jd = self._tool()
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        self._write_journal(a, 10.0, 1e12, retraces=2)
+        self._write_journal(b, 10.5, 1e12, retraces=4)  # retraces doubled
+        assert jd.main([a, b]) == 1
+        # ignoring retraces and loosening wall passes
+        assert jd.main(
+            [a, b, "--ignore", "retrace", "--default-threshold", "0.2"]
+        ) == 0
+
+    def test_bench_json_inputs(self, tmp_path):
+        jd = self._tool()
+        base = {"stage_times_seconds": {"year": 12.7},
+                "derived": {"weekly_solves_per_sec_per_chip": 13.7}}
+        worse = {"stage_times_seconds": {"year": 20.0},
+                 "derived": {"weekly_solves_per_sec_per_chip": 13.7}}
+        a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        with open(a, "w") as fh:
+            json.dump(base, fh)
+        with open(b, "w") as fh:
+            json.dump(worse, fh)
+        assert jd.main([a, a]) == 0
+        assert jd.main([a, b]) == 1
+        # throughput drop is a regression even though the number went down
+        worse2 = dict(base, derived={"weekly_solves_per_sec_per_chip": 9.0})
+        with open(b, "w") as fh:
+            json.dump(worse2, fh)
+        assert jd.main([a, b]) == 1
+
+    def test_no_common_metrics_is_an_error(self, tmp_path):
+        jd = self._tool()
+        a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        with open(a, "w") as fh:
+            json.dump({"x": 1.0}, fh)
+        with open(b, "w") as fh:
+            json.dump({"y": 1.0}, fh)
+        assert jd.main([a, b]) == 2
+
+    def test_self_check_in_process(self):
+        jd = self._tool()
+        assert jd.main(["--self-check"]) == 0
+
+    def test_self_check_cli(self):
+        # the tier-1 CI hook, exactly as wired: a subprocess exit code
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "journal_diff.py"),
+             "--self-check"],
+            capture_output=True, text=True, env=env, timeout=60,
+        )
+        assert res.returncode == 0, res.stdout + res.stderr
+
+    def test_real_journal_roundtrip(self, tmp_path):
+        # a journal produced by the actual Tracer diffs clean against
+        # itself through the actual extractor
+        jd = self._tool()
+        p = str(tmp_path / "real.jsonl")
+        tracer = Tracer(p)
+        with use_tracer(tracer):
+            with tracer.span("stage"):
+                sol = solve_lp(_toy_lp(), max_iter=20)
+            tracer.solve_event(
+                "lp", sol, cost=obs_cost.lp_solve_cost(_toy_lp(), max_iter=20)
+            )
+        tracer.close()
+        table = jd.load_metrics(p)
+        assert any(k.startswith("span/stage/wall_s") for k in table)
+        assert table["solve/lp/cost/flops"] > 0
+        assert jd.main([p, p]) == 0

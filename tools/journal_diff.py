@@ -1,0 +1,417 @@
+#!/usr/bin/env python
+"""Regression gate: diff two run journals (or BENCH_*.json artifacts).
+
+    python tools/journal_diff.py BASELINE NEW [options]
+    python tools/journal_diff.py --self-check
+
+Compares the comparable numeric surface of two runs — span wall-clock,
+solve iterations/convergence, retrace counts, XLA cost-model FLOPs/bytes,
+memory watermarks, metrics counters — and **exits nonzero when NEW is
+worse than BASELINE** by more than the threshold (default 10%, i.e. the
+acceptance bar in ISSUE 2). This is what lets the bench watch-loop and CI
+gate on "did this commit make the solver slower / hungrier" instead of
+eyeballing BENCH trajectories.
+
+Inputs may be either format, in any combination:
+  *.jsonl   — an `obs.journal` run journal; the LAST run in the file is
+              used (a journal file may hold many appended runs).
+  *.json    — any nested-dict artifact with numeric leaves
+              (BENCH_DIAG.json, BENCH_R4_CHIP_ANCHORS.json, ...).
+
+Direction is inferred per metric name: wall/seconds/iterations/retraces/
+flops/bytes/memory regress *upward*; solves_per_sec/converged/mfu/
+tflops/utilization regress *downward*. Unknown names default to
+lower-is-better (the conservative reading for a cost-like surface).
+
+Options:
+  --threshold PAT=FRAC  per-metric threshold override; PAT is a substring
+                        match, first match wins, repeatable
+                        (e.g. --threshold wall_s=0.25 --threshold flops=0.0)
+  --default-threshold F fallback threshold (default 0.10)
+  --only PAT            compare only metrics containing PAT (repeatable)
+  --ignore PAT          drop metrics containing PAT (repeatable)
+  --list                print the extracted metric table for each input
+  --self-check          run the built-in synthetic scenarios and exit
+
+Exit codes: 0 = no regression, 1 = regression(s), 2 = usage/extraction
+error (including no comparable metrics in common).
+
+Stdlib-only on purpose: the gate must run anywhere a journal lands,
+including hosts without jax installed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.10
+
+# substring -> direction; first match wins, checked in order
+_HIGHER_IS_BETTER = (
+    "per_sec", "per_chip", "converged", "mfu", "tflops", "utilization",
+    "throughput",
+)
+
+
+def lower_is_better(metric: str) -> bool:
+    m = metric.lower()
+    return not any(pat in m for pat in _HIGHER_IS_BETTER)
+
+
+# ---------------------------------------------------------------------
+# extraction
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def flatten_numeric(obj: Any, prefix: str = "") -> Dict[str, float]:
+    """All numeric leaves of a nested dict/list as {slash/path: value}."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(flatten_numeric(v, f"{prefix}/{k}" if prefix else str(k)))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(flatten_numeric(v, f"{prefix}/{i}" if prefix else str(i)))
+    elif _is_num(obj):
+        out[prefix] = float(obj)
+    return out
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    # same torn-line tolerance as obs.journal.read_journal, inlined to
+    # keep this tool stdlib-only
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _last_run(records: List[dict]) -> List[dict]:
+    starts = [i for i, r in enumerate(records) if r.get("kind") == "manifest"]
+    return records[starts[-1]:] if starts else records
+
+
+def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
+    """The comparable surface of one journal run.
+
+    Repeated spans/solves with the same name (sweep loops) are aggregated:
+    wall-clock, retraces, FLOPs and counters sum; memory watermarks max.
+    """
+    out: Dict[str, float] = {}
+
+    def add(key: str, v: float) -> None:
+        out[key] = out.get(key, 0.0) + v
+
+    def hi(key: str, v: float) -> None:
+        out[key] = max(out.get(key, v), v)
+
+    for rec in _last_run(records):
+        kind = rec.get("kind")
+        if kind == "span_end":
+            span = rec.get("span", "?")
+            if _is_num(rec.get("wall_s")):
+                add(f"span/{span}/wall_s", float(rec["wall_s"]))
+            retr = rec.get("retraces")
+            if isinstance(retr, dict):
+                n = sum(
+                    v for sig in retr.values() if isinstance(sig, dict)
+                    for v in sig.values() if _is_num(v)
+                )
+                if n:
+                    add(f"span/{span}/retraces", float(n))
+            if _is_num(rec.get("mem_watermark_bytes")):
+                hi(f"span/{span}/mem_watermark_bytes",
+                   float(rec["mem_watermark_bytes"]))
+            mets = rec.get("metrics")
+            if isinstance(mets, dict):
+                for series, v in mets.items():
+                    if _is_num(v):
+                        add(f"metric/{series}", float(v))
+        elif kind == "solve":
+            name = rec.get("name", "?")
+            stats = rec.get("stats")
+            if isinstance(stats, dict):
+                if _is_num(stats.get("batch")):
+                    add(f"solve/{name}/batch", float(stats["batch"]))
+                it = stats.get("iterations")
+                if isinstance(it, dict):
+                    for k in ("median", "max"):
+                        if _is_num(it.get(k)):
+                            add(f"solve/{name}/iterations_{k}", float(it[k]))
+                if _is_num(stats.get("nonfinite_count")):
+                    add(f"solve/{name}/nonfinite_count",
+                        float(stats["nonfinite_count"]))
+                if _is_num(stats.get("converged_frac")):
+                    # min over repeats: one bad batch in a sweep is a
+                    # regression even if the others are clean
+                    key = f"solve/{name}/converged_frac"
+                    v = float(stats["converged_frac"])
+                    out[key] = min(out.get(key, v), v)
+            cost = rec.get("cost")
+            if isinstance(cost, dict):
+                for k in ("flops", "bytes_accessed", "peak_bytes",
+                          "temp_bytes"):
+                    if _is_num(cost.get(k)):
+                        add(f"solve/{name}/cost/{k}", float(cost[k]))
+                rl = cost.get("roofline")
+                if isinstance(rl, dict) and _is_num(rl.get("utilization")):
+                    hi(f"solve/{name}/cost/utilization",
+                       float(rl["utilization"]))
+        elif kind == "close":
+            totals = rec.get("retrace_totals")
+            if isinstance(totals, dict):
+                n = sum(v for v in totals.values() if _is_num(v))
+                add("retrace_total", float(n))
+            mets = rec.get("metrics")
+            if isinstance(mets, dict):
+                for series, v in (mets.get("counters") or {}).items():
+                    if _is_num(v):
+                        add(f"metric/{series}", float(v))
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    """Extract the metric table from a journal (.jsonl) or a nested-dict
+    JSON artifact. Sniffs content, not just extension: a .json holding a
+    journal-style record list still works."""
+    if path.endswith(".jsonl"):
+        return metrics_from_journal(_read_jsonl(path))
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            obj = json.load(fh)
+    except json.JSONDecodeError:
+        return metrics_from_journal(_read_jsonl(path))
+    if isinstance(obj, list) and any(
+        isinstance(r, dict) and r.get("kind") == "manifest" for r in obj
+    ):
+        return metrics_from_journal([r for r in obj if isinstance(r, dict)])
+    return flatten_numeric(obj)
+
+
+# ---------------------------------------------------------------------
+# comparison
+
+
+def pick_threshold(
+    metric: str, overrides: List[Tuple[str, float]], default: float
+) -> float:
+    for pat, frac in overrides:
+        if pat in metric:
+            return frac
+    return default
+
+
+def compare(
+    base: Dict[str, float],
+    new: Dict[str, float],
+    overrides: Optional[List[Tuple[str, float]]] = None,
+    default_threshold: float = DEFAULT_THRESHOLD,
+) -> List[dict]:
+    """Per-common-metric comparison rows; `regression=True` where NEW is
+    worse than BASELINE by more than the metric's threshold."""
+    overrides = overrides or []
+    rows: List[dict] = []
+    for metric in sorted(set(base) & set(new)):
+        b, n = base[metric], new[metric]
+        thr = pick_threshold(metric, overrides, default_threshold)
+        lib = lower_is_better(metric)
+        if b == 0.0:
+            # can't form a ratio; any worsening from exactly zero (new
+            # retraces, new failures) trips a lower-is-better gate
+            change = float("inf") if n > 0 else 0.0
+            worse = n > 0 if lib else n < 0
+        else:
+            change = (n - b) / abs(b)
+            worse = change > thr if lib else change < -thr
+        rows.append({
+            "metric": metric,
+            "base": b,
+            "new": n,
+            "change": change,
+            "threshold": thr,
+            "direction": "lower_is_better" if lib else "higher_is_better",
+            "regression": bool(worse and (b != 0.0 or lib)),
+        })
+    return rows
+
+
+def _fmt_change(c: float) -> str:
+    if c == float("inf"):
+        return "+inf"
+    return f"{c:+.1%}"
+
+
+def render(rows: List[dict], out=sys.stdout, verbose: bool = False) -> None:
+    regressions = [r for r in rows if r["regression"]]
+    shown = rows if verbose else regressions
+    if shown:
+        w = max(len(r["metric"]) for r in shown)
+        for r in shown:
+            flag = "REGRESSION" if r["regression"] else "ok"
+            print(
+                f"{r['metric']:<{w}}  {r['base']:>14.6g} -> {r['new']:>14.6g}"
+                f"  {_fmt_change(r['change']):>8}"
+                f"  (thr {r['threshold']:.0%}, {r['direction']})  {flag}",
+                file=out,
+            )
+    print(
+        f"{len(rows)} metrics compared, {len(regressions)} regression(s)",
+        file=out,
+    )
+
+
+# ---------------------------------------------------------------------
+# self-check
+
+
+def self_check(out=sys.stdout) -> int:
+    """Synthetic scenarios asserting the gate's pass/fail behavior; the
+    tier-1 CI hook (`tools/journal_diff.py --self-check`) and a unit test
+    both run this."""
+    base = {
+        "span/year_sweep/wall_s": 10.0,
+        "solve/year_batch/cost/flops": 1e12,
+        "solve/year_batch/converged_frac": 1.0,
+        "retrace_total": 4.0,
+        "derived/weekly_solves_per_sec_per_chip": 13.7,
+    }
+    checks: List[Tuple[str, bool, bool]] = []
+
+    def run(name: str, new: Dict[str, float], expect_regression: bool,
+            **kw: Any) -> None:
+        rows = compare(base, new, **kw)
+        got = any(r["regression"] for r in rows)
+        checks.append((name, expect_regression, got))
+
+    run("identical runs pass", dict(base), False)
+    run("5% slower within 10% passes",
+        {**base, "span/year_sweep/wall_s": 10.5}, False)
+    run("20% wall-clock regression fails",
+        {**base, "span/year_sweep/wall_s": 12.0}, True)
+    run("15% FLOPs regression fails",
+        {**base, "solve/year_batch/cost/flops": 1.15e12}, True)
+    run("FLOPs *drop* passes (lower is better)",
+        {**base, "solve/year_batch/cost/flops": 0.5e12}, False)
+    run("throughput drop fails (higher is better)",
+        {**base, "derived/weekly_solves_per_sec_per_chip": 10.0}, True)
+    run("throughput gain passes",
+        {**base, "derived/weekly_solves_per_sec_per_chip": 20.0}, False)
+    run("convergence drop fails",
+        {**base, "solve/year_batch/converged_frac": 0.8}, True)
+    run("tightened per-metric threshold fails a 5% slip",
+        {**base, "span/year_sweep/wall_s": 10.5}, True,
+        overrides=[("wall_s", 0.0)])
+    run("loosened default threshold passes a 20% slip",
+        {**base, "span/year_sweep/wall_s": 12.0}, False,
+        default_threshold=0.5)
+    zero = {**base, "retrace_total": 0.0}
+    rows = compare(zero, {**zero, "retrace_total": 3.0})
+    checks.append(("retraces appearing from zero fail",
+                   True, any(r["regression"] for r in rows)))
+
+    ok = True
+    for name, want, got in checks:
+        status = "ok" if want == got else "FAIL"
+        if want != got:
+            ok = False
+        print(f"  [{status}] {name} (expect regression={want}, got {got})",
+              file=out)
+    print(("self-check passed" if ok else "self-check FAILED")
+          + f" ({len(checks)} scenarios)", file=out)
+    return 0 if ok else 2
+
+
+# ---------------------------------------------------------------------
+# CLI
+
+
+def _parse_threshold(spec: str) -> Tuple[str, float]:
+    if "=" not in spec:
+        raise argparse.ArgumentTypeError(
+            f"--threshold wants PATTERN=FRACTION, got {spec!r}"
+        )
+    pat, _, frac = spec.rpartition("=")
+    try:
+        return pat, float(frac)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--threshold fraction must be a number, got {frac!r}"
+        )
+
+
+def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="journal_diff",
+        description="Diff two run journals / BENCH json artifacts and "
+        "exit nonzero on regression.",
+    )
+    ap.add_argument("baseline", nargs="?", help="baseline journal/json")
+    ap.add_argument("new", nargs="?", help="candidate journal/json")
+    ap.add_argument("--threshold", action="append", default=[],
+                    type=_parse_threshold, metavar="PAT=FRAC",
+                    help="per-metric threshold override (substring match)")
+    ap.add_argument("--default-threshold", type=float,
+                    default=DEFAULT_THRESHOLD)
+    ap.add_argument("--only", action="append", default=[],
+                    help="compare only metrics containing this substring")
+    ap.add_argument("--ignore", action="append", default=[],
+                    help="drop metrics containing this substring")
+    ap.add_argument("--list", action="store_true",
+                    help="print extracted metric tables and all rows")
+    ap.add_argument("--self-check", action="store_true",
+                    help="run built-in synthetic scenarios and exit")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check(out)
+    if not args.baseline or not args.new:
+        ap.print_usage(file=out)
+        print("journal_diff: need BASELINE and NEW (or --self-check)",
+              file=out)
+        return 2
+
+    try:
+        base = load_metrics(args.baseline)
+        new = load_metrics(args.new)
+    except OSError as e:
+        print(f"journal_diff: {e}", file=out)
+        return 2
+
+    def keep(m: str) -> bool:
+        if args.only and not any(p in m for p in args.only):
+            return False
+        return not any(p in m for p in args.ignore)
+
+    base = {k: v for k, v in base.items() if keep(k)}
+    new = {k: v for k, v in new.items() if keep(k)}
+
+    if args.list:
+        for label, table in (("baseline", base), ("new", new)):
+            print(f"-- {label}: {len(table)} metrics", file=out)
+            for k in sorted(table):
+                print(f"   {k} = {table[k]:.6g}", file=out)
+
+    rows = compare(base, new, args.threshold, args.default_threshold)
+    if not rows:
+        print("journal_diff: no comparable metrics in common", file=out)
+        return 2
+    render(rows, out, verbose=args.list)
+    return 1 if any(r["regression"] for r in rows) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

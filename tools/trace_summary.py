@@ -34,17 +34,23 @@ if REPO not in sys.path:
 
 def _read_journal(path: str) -> List[dict]:
     # local JSONL reader (same torn-line policy as obs.journal.read_journal)
-    # so summarizing a journal never needs to import jax
+    # so summarizing a journal never needs to import jax. A killed run's
+    # torn last line can fail THREE ways, all tolerated here: invalid JSON,
+    # valid-but-non-dict JSON (a record truncated to `42` or `null` —
+    # .get() on it would raise), and a tear mid-UTF-8-sequence (a decode
+    # error before json even runs, hence errors="replace")
     out: List[dict] = []
-    with open(path, "r", encoding="utf-8") as fh:
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
         for line in fh:
             line = line.strip()
             if not line:
                 continue
             try:
-                out.append(json.loads(line))
+                rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            if isinstance(rec, dict):
+                out.append(rec)
     return out
 
 
@@ -147,6 +153,22 @@ def _print_solves(run: List[dict], out) -> None:
             flag = f"  DIVERGENT x{nd}" if nd else ""
             rng = f"{min(rec)}..{max(rec)}" if rec else "none"
             print(f"      trace: recorded iters {rng}{flag}", file=out)
+        cost = ev.get("cost")
+        if isinstance(cost, dict):
+            parts = []
+            if isinstance(cost.get("flops"), (int, float)):
+                parts.append(f"flops={cost['flops']:.3g}")
+            if isinstance(cost.get("bytes_accessed"), (int, float)):
+                parts.append(f"bytes={cost['bytes_accessed']:.3g}")
+            if isinstance(cost.get("peak_bytes"), (int, float)):
+                parts.append(f"peak_mem={cost['peak_bytes'] / 2**20:.0f}MiB")
+            rl = cost.get("roofline")
+            if isinstance(rl, dict) and isinstance(
+                rl.get("utilization"), (int, float)
+            ):
+                parts.append(f"roofline={rl['utilization']:.2%}")
+            if parts:
+                print(f"      cost: {' '.join(parts)}", file=out)
 
 
 def _print_run(run: List[dict], out, max_spans: int) -> None:
@@ -169,6 +191,12 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
         if totals:
             txt = ", ".join(f"{k}: {v}" for k, v in sorted(totals.items()))
             print(f"  retrace totals: {txt}", file=out)
+        counters = (close.get("metrics") or {}).get("counters") or {}
+        if counters:
+            txt = ", ".join(
+                f"{k}={v:g}" for k, v in sorted(counters.items())
+            )
+            print(f"  metrics: {txt}", file=out)
     else:
         # no close record — the run died; sum span deltas as best effort
         totals: dict = {}
