@@ -83,6 +83,11 @@ _OFF_RECORD = _SMOKE or _FORCE_CPU
 # peak memory + roofline) to the year rows. Opt-in: the cost probe
 # compiles the solver a second time outside the jit call cache.
 _COST = os.environ.get("BENCH_COST") == "1"
+# BENCH_RECORD_DIR=path: install the obs.recorder flight recorder — every
+# failed/non-healthy solve row snapshots its problem instance into a capped
+# ring buffer (50 captures / 256 MiB) under this directory, replayable with
+# tools/replay_solve.py. Opt-in like the profiler.
+_RECORD_DIR = os.environ.get("BENCH_RECORD_DIR")
 # --profile-dir DIR (or BENCH_PROFILE_DIR): capture a jax.profiler trace
 # of the bench run; journal span names become profiler TraceAnnotations.
 # Parsed here, *entered* inside main() after the platform is pinned —
@@ -207,6 +212,33 @@ def _flush_local():
     _atomic_dump(_LOCAL, _LOCAL_PATH)
 
 
+def _note_verdicts(row, conv, iters, budget):
+    """Health-verdict histogram for one bench row: classify every lane's
+    end state (obs.health), bump `solve_verdict_total` counters, and record
+    the counts under BENCH_DIAG.json's `verdicts` so the BENCH_* trajectory
+    carries solve health alongside timing. Returns the counts dict; any
+    diagnosis error degrades to {} rather than touching the bench."""
+    try:
+        from types import SimpleNamespace
+
+        from dispatches_tpu.obs import health as _health
+
+        sol = SimpleNamespace(
+            converged=np.atleast_1d(np.asarray(conv)),
+            iterations=np.atleast_1d(np.asarray(iters)),
+        )
+        verdicts = _health.classify_solution(sol, budget=budget)
+        counts = {}
+        for v in verdicts:
+            counts[v.verdict] = counts.get(v.verdict, 0) + 1
+        _health.note_verdicts(counts, solve=row)
+        _DIAG.setdefault("verdicts", {})[row] = counts
+        _atomic_dump(_DIAG, _DIAG_PATH)
+        return counts
+    except Exception:
+        return {}
+
+
 def _fail(stage, n_attempts, fatal_fast=False):
     _write_diag(stage)
     _journal().event(
@@ -278,10 +310,6 @@ def _fail(stage, n_attempts, fatal_fast=False):
     sys.exit(1)
 
 
-class _StageTimeout(Exception):
-    pass
-
-
 def _device(stage, fn, timeout_s=900.0):
     """Run a device-touching thunk under retry-with-backoff AND a watchdog.
 
@@ -289,34 +317,12 @@ def _device(stage, fn, timeout_s=900.0):
     re-raises at once (after writing diagnostics) so the traceback reaches
     the driver log. The watchdog covers the tunnel's third failure mode —
     calls that HANG instead of erroring (observed round 4: a warmup batch
-    blocked >15 min at 0% CPU) — by running the thunk in a worker thread
-    and abandoning it past `timeout_s` (the stuck thread cannot be killed,
-    but the bench can move on to retry or fail with diagnostics)."""
-    import queue as _queue
-    import threading
-
-    def run_with_watchdog():
-        # plain daemon thread (NOT ThreadPoolExecutor: its atexit hook
-        # joins workers, so a stuck tunnel call would hang process exit)
-        q = _queue.Queue()
-
-        def worker():
-            try:
-                q.put(("ok", fn()))
-            except Exception as exc:  # delivered to the retry loop below
-                q.put(("err", exc))
-
-        threading.Thread(target=worker, daemon=True).start()
-        try:
-            kind, val = q.get(timeout=timeout_s)
-        except _queue.Empty:
-            raise _StageTimeout(
-                f"device call hung > {timeout_s:.0f}s (tunnel "
-                "unavailable-by-hang)"
-            )
-        if kind == "err":
-            raise val
-        return val
+    blocked >15 min at 0% CPU): `obs.watchdog.with_watchdog` runs the thunk
+    in a daemon worker thread, abandons it past `timeout_s`, and journals a
+    `hang` verdict with an all-thread stack dump (the stuck thread cannot
+    be killed, but the bench can move on to retry or fail with
+    diagnostics)."""
+    from dispatches_tpu.obs.watchdog import WatchdogTimeout, with_watchdog
 
     # stage span: wall-clock (incl. backoff sleeps), retrace delta, and
     # every failed attempt land in the journal; stage_times/attempts in
@@ -327,7 +333,7 @@ def _device(stage, fn, timeout_s=900.0):
                 time.sleep(delay)
             t0 = time.perf_counter()
             try:
-                out = run_with_watchdog()
+                out = with_watchdog(fn, timeout_s=timeout_s, stage=stage)
                 dt = round(time.perf_counter() - t0, 3)
                 _DIAG["stage_times"][stage] = dt
                 _journal().metric("stage_seconds", dt, attempt=i + 1)
@@ -350,7 +356,7 @@ def _device(stage, fn, timeout_s=900.0):
                     file=sys.stderr,
                     flush=True,
                 )
-                if isinstance(e, _StageTimeout):
+                if isinstance(e, WatchdogTimeout):
                     continue  # retryable by definition
                 if any(pat in msg.lower() for pat in _FATAL_FAST):
                     _write_diag(stage, fatal_error=traceback.format_exc()[-8000:])
@@ -559,6 +565,10 @@ def main():
 
         _PROFILE_CM = profile_capture(_PROFILE_DIR)
         _PROFILE_CM.__enter__()  # closed in the __main__ finally
+    if _RECORD_DIR:
+        from dispatches_tpu.obs import FlightRecorder, set_recorder
+
+        set_recorder(FlightRecorder(_RECORD_DIR))
     from dispatches_tpu.case_studies.renewables import params as P
     from dispatches_tpu.case_studies.renewables.pricetaker import (
         HybridDesign,
@@ -684,12 +694,35 @@ def main():
         "solves_per_sec": round(solves_per_sec, 3),
         "converged": conv_frac,
         "median_iters": med_iters,
+        "verdicts": _note_verdicts("weekly", conv, iters, budget=60),
     }
     _flush_local()
 
     # Convergence gate: a throughput number for solves that did not converge
     # is not a benchmark (round-1 lesson: 679k "solves/sec" at converged=0).
     if conv_frac < 0.99:
+        # flight recorder: snapshot the first unconverged lane's LP before
+        # exiting, so the instance that failed the gate can be replayed
+        # offline (BENCH_RECORD_DIR opt-in; no-op otherwise)
+        try:
+            from dispatches_tpu.obs import maybe_capture
+
+            bad = int(np.flatnonzero(~np.asarray(conv, dtype=bool))[0])
+            maybe_capture(
+                "solve_lp",
+                verdict="stalled",
+                problem=prog.instantiate(
+                    {"lmp": jnp.asarray(lmps_used[bad], jnp.float32),
+                     "wind_cf": jnp.asarray(cfs[bad], jnp.float32)},
+                    dtype=jnp.float32,
+                ),
+                options=dict(tol=tol, max_iter=60, refine_steps=2,
+                             stall_limit=10),
+                extra={"row": "weekly", "lane": bad,
+                       "converged_frac": conv_frac},
+            )
+        except Exception:
+            pass
         _journal().event(
             "gate_failed", gate="weekly convergence", converged=conv_frac
         )
@@ -808,6 +841,9 @@ def main():
         "seconds": round(ydt, 3),
         "converged": yconv,
         "iterations": yiters,
+        "verdicts": _note_verdicts(
+            "year_single", [yconv], [yiters], budget=ykw["max_iter"]
+        ),
     }
     _flush_local()
     # HiGHS year objective for the SAME (jittered) inputs: the accuracy
@@ -869,6 +905,11 @@ def main():
         _LOCAL["rows"]["year_batch"].update(
             {
                 "scenario_years_per_min": round(scen_years_per_min, 3),
+                "verdicts": _note_verdicts(
+                    "year_batch", yb["converged"],
+                    yb.get("iterations", [YEAR_KW["max_iter"]] * By),
+                    budget=YEAR_KW["max_iter"],
+                ),
                 "converged_frac": yb_conv_frac,
                 "scen0_rel_err_vs_highs": yb_err,
                 "projected_500_scenarios_min": round(t500 / 60.0, 2),

@@ -71,7 +71,12 @@ class DoubleLoopCoordinator:
 
     def track_sced_dispatch(self, dispatch, day: int, hour: int):
         with get_tracer().span("track_sced", day=day, hour=hour):
-            return self.tracker.track_market_dispatch(dispatch, day, hour)
+            sol = self.tracker.track_market_dispatch(dispatch, day, hour)
+            # solve_event attaches batch_stats + an obs.health verdict to
+            # the span, so a double-loop day whose tracking LP stalls is
+            # diagnosed in the journal, not just slower
+            get_tracer().solve_event("track_sced", sol, day=day, hour=hour)
+            return sol
 
     # -- Prescient interop (optional dependency) -------------------------
     @property

@@ -17,6 +17,12 @@ Three pillars (see docs/observability.md):
    measured matmul peak.
 6. **Profiler capture** (`obs.profile`): opt-in `jax.profiler` traces
    whose `TraceAnnotation`s mirror journal span names.
+7. **Solver health engine** (`obs.health`, `obs.recorder`,
+   `obs.watchdog`): per-solve verdicts (healthy / slow / stalled /
+   diverged / cycling / nonfinite) with first-bad-iteration provenance,
+   an opt-in flight recorder that snapshots failing problem instances
+   into a capped ring buffer for `tools/replay_solve.py`, and a shared
+   hang guard that journals stuck device calls as a `hang` verdict.
 """
 from .cost import (  # noqa: F401
     chip_peak_tflops,
@@ -28,6 +34,17 @@ from .cost import (  # noqa: F401
     pdhg_solve_cost,
     roofline,
     with_roofline,
+)
+from .health import (  # noqa: F401
+    Verdict,
+    classify_solution,
+    classify_trace,
+    classify_trajectory,
+    health_summary,
+    note_verdicts,
+    severity,
+    verdict_from_stats,
+    worst_verdict,
 )
 from .journal import (  # noqa: F401
     NullTracer,
@@ -56,6 +73,13 @@ from .profile import (  # noqa: F401
     profiler_available,
     profiling_active,
 )
+from .recorder import (  # noqa: F401
+    FlightRecorder,
+    get_recorder,
+    load_capture,
+    maybe_capture,
+    set_recorder,
+)
 from .retrace import (  # noqa: F401
     note_trace,
     reset_retrace_counts,
@@ -72,6 +96,7 @@ from .trace import (  # noqa: F401
     recorded_iterations,
     trace_stats,
 )
+from .watchdog import WatchdogTimeout, with_watchdog  # noqa: F401
 
 __all__ = [
     "SolveTrace",
@@ -117,4 +142,20 @@ __all__ = [
     "profile_capture",
     "profiler_available",
     "profiling_active",
+    "Verdict",
+    "classify_trajectory",
+    "classify_trace",
+    "classify_solution",
+    "health_summary",
+    "verdict_from_stats",
+    "note_verdicts",
+    "severity",
+    "worst_verdict",
+    "FlightRecorder",
+    "get_recorder",
+    "set_recorder",
+    "maybe_capture",
+    "load_capture",
+    "WatchdogTimeout",
+    "with_watchdog",
 ]

@@ -282,6 +282,15 @@ class Tracer:
                 rec["trace"] = trace_stats(trace)
             except Exception as e:
                 rec["trace_error"] = f"{type(e).__name__}: {e}"
+        try:
+            from . import health as _health
+
+            summary = _health.health_summary(sol, trace=trace)
+            if summary is not None:
+                rec["health"] = summary
+                _health.note_verdicts(summary, solve=name)
+        except Exception as e:  # diagnosis must never kill the run
+            rec["health_error"] = f"{type(e).__name__}: {e}"
         self._emit(rec)
 
     def close(self) -> None:

@@ -1,0 +1,266 @@
+"""Failure flight recorder (observability pillar 7): capture the LP that
+broke.
+
+Before this module, the only artifact of a failed solve was a status code —
+the problem instance that produced it was gone with the process. The
+recorder snapshots the full instance on any non-``healthy`` verdict (or
+telemetry failure record): problem arrays via ``np.savez``, the solver entry
+point name and options, an optional warm start, the observed solution, and a
+reproducibility manifest from :func:`obs.journal.build_manifest` — into a
+**capped ring-buffer** directory, so a week-long sweep can't fill a disk with
+its own post-mortems.
+
+Capture layout (one directory per capture, lexically sorted = age sorted)::
+
+    <dir>/cap-000017-solve_lp/
+        arrays.npz    # problem.<field>, sol.<field>, warm.<k>, extra.<k>
+        meta.json     # solver, problem_type, options, verdict, manifest
+
+Opt-in by design: nothing records until a recorder is installed
+(`set_recorder`, or the workflow CLI's ``--record-failures DIR``, or
+bench.py's ``BENCH_RECORD_DIR``). `tools/replay_solve.py` reloads a capture
+and reruns the exact solver entry point to reproduce the failure bitwise.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+# ring-buffer defaults (documented in docs/observability.md §7): captures
+# beyond either cap evict oldest-first. A weekly LPData at T=168 is ~15 MiB
+# in f64; a full-year BandedLP batch can reach ~100 MiB — the byte cap, not
+# the count cap, is the binding one for year-scale captures.
+DEFAULT_MAX_CAPTURES = 50
+DEFAULT_MAX_BYTES = 256 * 2**20
+
+# problem NamedTuples the replay CLI knows how to rebuild; other problem
+# types (BandedLP, NLP array bundles) still capture for offline analysis
+REPLAYABLE = ("solve_lp", "solve_lp_pdhg")
+
+
+def _json_safe(obj: Any) -> Any:
+    """Options dicts may carry numpy scalars or jnp dtypes; meta.json must
+    round-trip them as plain JSON (dtypes as strings)."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (str, bool)) or obj is None:
+        return obj
+    if isinstance(obj, (int, float)):
+        return obj
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.bool_):
+        return bool(obj)
+    return str(obj)
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+class FlightRecorder:
+    """Capped ring-buffer capture directory. Thread-compat: captures are
+    written under a temp name and renamed, so a reader (replay tool, a
+    human) never sees a torn capture."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_captures: int = DEFAULT_MAX_CAPTURES,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+    ):
+        self.directory = os.path.abspath(directory)
+        self.max_captures = int(max_captures)
+        self.max_bytes = int(max_bytes)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- internals -----------------------------------------------------
+    def _captures(self):
+        try:
+            names = sorted(
+                n for n in os.listdir(self.directory) if n.startswith("cap-")
+            )
+        except OSError:
+            return []
+        return [os.path.join(self.directory, n) for n in names]
+
+    def _next_seq(self) -> int:
+        seq = 0
+        for p in self._captures():
+            try:
+                seq = max(seq, int(os.path.basename(p).split("-")[1]))
+            except (IndexError, ValueError):
+                pass
+        return seq + 1
+
+    def _enforce_caps(self) -> None:
+        caps = self._captures()
+        while caps and len(caps) > self.max_captures:
+            shutil.rmtree(caps.pop(0), ignore_errors=True)
+        total = sum(_dir_bytes(p) for p in caps)
+        while caps and len(caps) > 1 and total > self.max_bytes:
+            victim = caps.pop(0)
+            total -= _dir_bytes(victim)
+            shutil.rmtree(victim, ignore_errors=True)
+
+    # -- public API ----------------------------------------------------
+    def capture(
+        self,
+        solver: str,
+        problem: Any = None,
+        options: Optional[dict] = None,
+        verdict: Any = None,
+        warm_start: Optional[Dict[str, Any]] = None,
+        solution: Any = None,
+        arrays: Optional[Dict[str, Any]] = None,
+        extra: Optional[dict] = None,
+    ) -> Optional[str]:
+        """Snapshot one failed solve; returns the capture directory (None
+        when writing failed — recording must never kill the run it
+        documents). `problem` is a NamedTuple of arrays (LPData / SparseLP /
+        BandedLP); solvers whose problems aren't array pytrees (NLP
+        callables) pass their array bundle via `arrays` instead."""
+        try:
+            from .journal import build_manifest, get_tracer
+
+            payload: Dict[str, np.ndarray] = {}
+            problem_type = None
+            if problem is not None and hasattr(problem, "_fields"):
+                problem_type = type(problem).__name__
+                for f in problem._fields:
+                    payload[f"problem.{f}"] = np.asarray(getattr(problem, f))
+            if solution is not None and hasattr(solution, "_fields"):
+                for f in solution._fields:
+                    payload[f"sol.{f}"] = np.asarray(getattr(solution, f))
+            for prefix, bundle in (("warm", warm_start), ("extra", arrays)):
+                for k, v in (bundle or {}).items():
+                    payload[f"{prefix}.{k}"] = np.asarray(v)
+
+            meta = {
+                "solver": solver,
+                "problem_type": problem_type,
+                "replayable": solver in REPLAYABLE and problem_type is not None,
+                "options": _json_safe(options or {}),
+                "verdict": _json_safe(
+                    verdict._asdict() if hasattr(verdict, "_asdict") else verdict
+                ),
+                "ts": time.time(),
+                "manifest": build_manifest({"tool": "flight_recorder"}),
+                "extra": _json_safe(extra or {}),
+            }
+
+            seq = self._next_seq()
+            name = f"cap-{seq:06d}-{solver.replace('/', '_')}"
+            final = os.path.join(self.directory, name)
+            tmp = f"{final}.{os.getpid()}.tmp"
+            os.makedirs(tmp, exist_ok=True)
+            np.savez(os.path.join(tmp, "arrays.npz"), **payload)
+            with open(os.path.join(tmp, "meta.json"), "w", encoding="utf-8") as fh:
+                json.dump(meta, fh, indent=1)
+            os.replace(tmp, final)
+            self._enforce_caps()
+            get_tracer().event(
+                "capture", solver=solver, path=final,
+                verdict=(meta["verdict"] or {}).get("verdict")
+                if isinstance(meta["verdict"], dict) else meta["verdict"],
+            )
+            return final
+        except Exception:
+            try:
+                shutil.rmtree(tmp, ignore_errors=True)  # type: ignore[possibly-undefined]
+            except Exception:
+                pass
+            return None
+
+
+def load_capture(path: str) -> dict:
+    """Reload a capture: meta.json plus the arrays, with the problem
+    NamedTuple reconstructed when its type is known. `path` may be the
+    capture directory or its arrays.npz."""
+    if path.endswith(".npz"):
+        path = os.path.dirname(path)
+    with open(os.path.join(path, "meta.json"), "r", encoding="utf-8") as fh:
+        meta = json.load(fh)
+    with np.load(os.path.join(path, "arrays.npz")) as dat:
+        arrays = {k: np.asarray(dat[k]) for k in dat.files}
+    out = {
+        "path": path,
+        "meta": meta,
+        "arrays": arrays,
+        "problem": None,
+        "solution": {
+            k.split(".", 1)[1]: v for k, v in arrays.items() if k.startswith("sol.")
+        },
+        "warm_start": {
+            k.split(".", 1)[1]: v for k, v in arrays.items() if k.startswith("warm.")
+        },
+    }
+    ptype = meta.get("problem_type")
+    pfields = {
+        k.split(".", 1)[1]: v for k, v in arrays.items() if k.startswith("problem.")
+    }
+    if ptype and pfields:
+        cls = None
+        try:
+            if ptype in ("LPData", "SparseLP"):
+                from ..core import program as _program
+
+                cls = getattr(_program, ptype, None)
+            elif ptype == "BandedLP":
+                from ..solvers import structured as _structured
+
+                cls = getattr(_structured, ptype, None)
+        except Exception:
+            cls = None
+        if cls is not None and set(cls._fields) <= set(pfields):
+            out["problem"] = cls(**{f: pfields[f] for f in cls._fields})
+        else:
+            out["problem"] = pfields
+    return out
+
+
+# ---------------------------------------------------------------------------
+# process-wide recorder (null-object free: None means "off")
+# ---------------------------------------------------------------------------
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def set_recorder(rec: Optional[FlightRecorder]) -> Optional[FlightRecorder]:
+    """Install `rec` (None disables recording); returns the previous one."""
+    global _RECORDER
+    prev = _RECORDER
+    _RECORDER = rec
+    return prev
+
+
+def maybe_capture(solver: str, verdict: Any = None, **kw) -> Optional[str]:
+    """Capture through the installed recorder, but only for a non-healthy
+    verdict (None counts as non-healthy: telemetry failure records have no
+    verdict object). No-op when no recorder is installed."""
+    rec = _RECORDER
+    if rec is None:
+        return None
+    v = getattr(verdict, "verdict", verdict)
+    if v == "healthy":
+        return None
+    return rec.capture(solver, verdict=verdict, **kw)

@@ -1,0 +1,90 @@
+"""Reusable hang guard for device-touching thunks.
+
+The tunnel's hang mode blocks device calls forever at 0% CPU (one of the
+four observed failure modes in BENCH_NOTES), so every long-running driver
+runs its device work through this: a daemon worker thread plus a timeout
+on the result queue. The stuck thread cannot be killed, but the process
+can raise, journal a ``hang`` verdict with an all-thread stack dump, and
+move on — the same pattern bench.py's `_device` grew inline and
+tools/_watchdog.py carried as a copy, now shared.
+
+IMPORTANT for callers: jax dispatch is asynchronous — the thunk must
+MATERIALIZE its result (np.asarray / float()) inside the thunk, or the
+watchdog returns before the device work happens and the unguarded
+synchronization hangs later.
+"""
+from __future__ import annotations
+
+import faulthandler
+import queue
+import tempfile
+import threading
+from typing import Any, Callable, Optional
+
+from . import metrics as _metrics
+from .journal import get_tracer
+
+DEFAULT_TIMEOUT_S = 600.0
+
+# keep the dump small enough to live inside a JSONL journal record
+_MAX_STACK_CHARS = 8000
+
+
+class WatchdogTimeout(TimeoutError):
+    """Raised when the guarded thunk exceeds its wall-clock budget."""
+
+
+def _dump_stacks() -> str:
+    """All-thread stack dump via faulthandler (needs a real fd, so a
+    TemporaryFile rather than StringIO); best-effort — a hang diagnostic
+    must never raise past the timeout it documents."""
+    try:
+        with tempfile.TemporaryFile("w+") as fh:
+            faulthandler.dump_traceback(file=fh, all_threads=True)
+            fh.seek(0)
+            return fh.read()[-_MAX_STACK_CHARS:]
+    except Exception:
+        return ""
+
+
+def with_watchdog(
+    fn: Callable[[], Any],
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+    stage: Optional[str] = None,
+) -> Any:
+    """Run `fn()` in a daemon thread; raise :class:`WatchdogTimeout` if no
+    result lands within `timeout_s`. On timeout the journal gets a ``hang``
+    event (stage, budget, stack dump) and `solve_verdict_total{verdict=
+    "hang"}` is bumped, so a hung driver leaves the same verdict trail as
+    a diverged solve. Exceptions from `fn` re-raise unchanged."""
+    q: "queue.Queue" = queue.Queue()
+
+    def worker():
+        try:
+            q.put(("ok", fn()))
+        except BaseException as exc:
+            q.put(("err", exc))
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        kind, val = q.get(timeout=timeout_s)
+    except queue.Empty:
+        stacks = _dump_stacks()
+        try:
+            get_tracer().event(
+                "hang",
+                stage=stage,
+                timeout_s=float(timeout_s),
+                verdict="hang",
+                stacks=stacks,
+            )
+            _metrics.inc("solve_verdict_total", verdict="hang")
+        except Exception:
+            pass
+        raise WatchdogTimeout(
+            f"{'stage ' + repr(stage) + ' ' if stage else ''}device call "
+            f"hung > {timeout_s:.0f}s"
+        )
+    if kind == "err":
+        raise val
+    return val

@@ -23,7 +23,9 @@ import numpy as np
 
 import jax
 
+from ..obs import health as _health
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 
 # iteration-count flavored buckets (the wall-clock default buckets are
 # wrong for a quantity that lives in [1, max_iter])
@@ -42,6 +44,7 @@ class SolveRecord:
     batch: int = 1
     failed: bool = False  # fn raised; `error` holds the exception type
     error: str = ""
+    verdict: str = "healthy"  # worst obs.health verdict across the batch
 
 
 def _field_max(sol, field, default=float("nan")) -> float:
@@ -73,10 +76,16 @@ class SolveTelemetry:
 
         Every observation also lands in the process metrics registry
         (`obs.metrics`): `solves_total`/`solve_failures_total` counters,
-        `solve_batch_total`, and `solve_wall_seconds`/`solve_iterations`
-        histograms, all labeled `solve="<name>"` — so journals pick up the
-        aggregate via the span-end flush with no per-runner dict plumbing.
+        `solve_batch_total`, `solve_verdict_total{verdict=...}` health
+        verdicts (via `obs.health.classify_solution`), and
+        `solve_wall_seconds`/`solve_iterations` histograms, all labeled
+        `solve="<name>"` — so journals pick up the aggregate via the
+        span-end flush with no per-runner dict plumbing. When a flight
+        recorder is installed (`obs.recorder.set_recorder`), any failed or
+        non-`healthy` solve whose problem instance is `args[0]` gets
+        captured for `tools/replay_solve.py`.
         All host-side: `fn`'s compiled computation is untouched."""
+        problem = args[0] if args and hasattr(args[0], "_fields") else None
         t0 = time.perf_counter()
         try:
             sol = fn(*args, **kwargs)
@@ -84,7 +93,12 @@ class SolveTelemetry:
             wall = time.perf_counter() - t0
             _metrics.inc("solve_failures_total", solve=name,
                          error=type(e).__name__)
+            _metrics.inc("solve_verdict_total", solve=name, verdict="failed")
             _metrics.observe("solve_wall_seconds", wall, solve=name)
+            _recorder.maybe_capture(
+                name, verdict="failed", problem=problem,
+                extra={"error": f"{type(e).__name__}: {e}"},
+            )
             self.records.append(
                 SolveRecord(
                     name=name,
@@ -97,6 +111,7 @@ class SolveTelemetry:
                     batch=0,
                     failed=True,
                     error=type(e).__name__,
+                    verdict="failed",
                 )
             )
             raise
@@ -117,6 +132,25 @@ class SolveTelemetry:
         _metrics.observe("solve_wall_seconds", wall, solve=name)
         _metrics.observe("solve_iterations", max_iters,
                          buckets=_ITER_BUCKETS, solve=name)
+        # health verdicts: end-state diagnosis (no trace rides through
+        # telemetry); a non-solution result (None/tuple) classifies as None
+        # and is recorded as healthy-by-absence
+        worst = "healthy"
+        try:
+            verdicts = _health.classify_solution(sol)
+            if verdicts is not None:
+                worst_v = _health.worst_verdict(verdicts)
+                worst = worst_v.verdict
+                counts: Dict[str, int] = {}
+                for v in verdicts:
+                    counts[v.verdict] = counts.get(v.verdict, 0) + 1
+                _health.note_verdicts(counts, solve=name)
+                if worst != "healthy":
+                    _recorder.maybe_capture(
+                        name, verdict=worst_v, problem=problem, solution=sol,
+                    )
+        except Exception:
+            pass  # diagnosis must never kill the solve it observes
         self.records.append(
             SolveRecord(
                 name=name,
@@ -127,6 +161,7 @@ class SolveTelemetry:
                 gap=_field_max(sol, "gap"),
                 wall_s=wall,
                 batch=int(conv.size),
+                verdict=worst,
             )
         )
         return sol
@@ -146,12 +181,13 @@ class SolveTelemetry:
     def __str__(self):
         lines = [
             f"{'solve':<24}{'batch':>6}{'iters':>7}{'conv':>6}"
-            f"{'gap':>11}{'wall [s]':>10}"
+            f"{'gap':>11}{'wall [s]':>10}  {'verdict'}"
         ]
         for r in self.records:
             lines.append(
                 f"{r.name:<24}{r.batch:>6}{r.iterations:>7}"
                 f"{str(r.converged):>6}{r.gap:>11.2e}{r.wall_s:>10.3f}"
+                f"  {r.verdict}"
             )
         return "\n".join(lines)
 

@@ -24,7 +24,9 @@ from typing import List, Optional
 import numpy as np
 
 from ..obs import get_tracer
+from ..obs import health as obs_health
 from ..obs import metrics as obs_metrics
+from ..obs import recorder as obs_recorder
 from ..runtime.native import ResultStore
 from .options import SimulationOptions
 
@@ -102,9 +104,11 @@ def run_pricetaker(
             }
             out.append(rec)
             obs_metrics.inc("sweep_points_total", runner="pricetaker")
+            verdict = obs_health.verdict_from_stats(rec["solver_stats"])
+            obs_health.note_verdicts({verdict: 1}, solve="pricetaker")
             tracer.event(
                 "point_result", point=i, h2_price=h2, NPV=rec["NPV"],
-                solver_stats=rec["solver_stats"],
+                solver_stats=rec["solver_stats"], verdict=verdict,
             )
             if store:
                 store.append(
@@ -182,10 +186,12 @@ def run_battery_ratio_sweep(
             if not rec["converged"]:
                 obs_metrics.inc("sweep_points_unconverged_total",
                                 runner="battsweep")
+            verdict = obs_health.verdict_from_stats(rec["solver_stats"])
+            obs_health.note_verdicts({verdict: 1}, solve="battsweep")
             tracer.event(
                 "point_result", point=i, ratio=ratio, duration_hrs=dur,
                 NPV=rec["NPV"], converged=rec["converged"],
-                solver_stats=rec["solver_stats"],
+                solver_stats=rec["solver_stats"], verdict=verdict,
             )
             if store and rec["converged"]:
                 store.append(
@@ -397,6 +403,31 @@ def run_year_sweep(
                 tracer.solve_event(
                     "year_batch", sol, trace=sol_tr, cost=batch_cost
                 )
+                # flight recorder (opt-in via --record-failures): snapshot
+                # the batched problem instance when any lane went bad, so
+                # the failing year-LP survives the sweep for offline
+                # analysis (banded captures are archival-only: the static
+                # meta isn't serialized, see tools/replay_solve.py)
+                if obs_recorder.get_recorder() is not None:
+                    try:
+                        summary = obs_health.health_summary(sol, trace=sol_tr)
+                        if summary and summary.get("n_bad"):
+                            w = summary["worst"]
+                            obs_recorder.maybe_capture(
+                                "solve_lp_banded_batch",
+                                verdict=obs_health.Verdict(
+                                    w["verdict"],
+                                    w["first_bad_iteration"],
+                                    w["quantity"],
+                                    w["detail"],
+                                ),
+                                problem=blp_b,
+                                solution=sol,
+                                options={**solver_kw, "block_hours": block_hours},
+                                extra={"scenarios": [int(k) for k in todo]},
+                            )
+                    except Exception:
+                        pass  # recording must never kill the sweep
             for j, k in enumerate(todo):
                 rec = {
                     "scenario": k,
@@ -513,6 +544,13 @@ def main(argv=None):
         "(TensorBoard-loadable); journal span names become profiler "
         "TraceAnnotations",
     )
+    p.add_argument(
+        "--record-failures", default=None, metavar="DIR",
+        help="flight recorder: snapshot every failed/non-healthy solve "
+        "(problem arrays + options + manifest) into a capped ring buffer "
+        "under DIR (default 50 captures / 256 MiB; replay with "
+        "tools/replay_solve.py)",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pt = sub.add_parser("pricetaker", help="price-taker design sweep")
@@ -581,6 +619,12 @@ def main(argv=None):
         set_tracer(tracer)
     from ..obs import profile_capture
 
+    recorder = None
+    if args.record_failures:
+        from ..obs import FlightRecorder, set_recorder
+
+        recorder = FlightRecorder(args.record_failures)
+        set_recorder(recorder)
     try:
         with profile_capture(args.profile_dir):
             if args.cmd == "pricetaker":
@@ -620,6 +664,10 @@ def main(argv=None):
                     cost=args.cost,
                 )
     finally:
+        if recorder is not None:
+            from ..obs import set_recorder
+
+            set_recorder(None)
         if tracer is not None:
             from ..obs import set_tracer
 

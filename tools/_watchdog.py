@@ -1,36 +1,16 @@
-"""Shared hang-mode watchdog for the chip tools.
-
-The tunnel's hang mode blocks device calls forever at 0% CPU
-(memory/BENCH_NOTES: one of the four observed failure modes), so every
-device-touching thunk in tools/ runs through this: a daemon worker
-thread plus a timeout on the result queue. The stuck thread cannot be
-killed, but the process can raise, move on, and exit — same pattern as
-bench.py's `_device`, minus its retry/diagnostics machinery which the
-one-shot tools don't want.
-
-IMPORTANT for callers: jax dispatch is asynchronous — the thunk must
-MATERIALIZE its result (np.asarray / float()) inside the thunk, or the
-watchdog returns before the device work happens and the unguarded
-synchronization hangs later.
+"""Back-compat shim: the hang-mode watchdog now lives in
+dispatches_tpu.obs.watchdog (promoted so bench.py and the tools/ drivers
+share one implementation). Importers of `from _watchdog import
+with_watchdog` keep working; new code should import from the package.
 """
-import queue
-import threading
+import os
+import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-def with_watchdog(fn, timeout_s=600.0):
-    q = queue.Queue()
-
-    def worker():
-        try:
-            q.put(("ok", fn()))
-        except Exception as exc:
-            q.put(("err", exc))
-
-    threading.Thread(target=worker, daemon=True).start()
-    try:
-        kind, val = q.get(timeout=timeout_s)
-    except queue.Empty:
-        raise TimeoutError(f"device call hung > {timeout_s:.0f}s")
-    if kind == "err":
-        raise val
-    return val
+from dispatches_tpu.obs.watchdog import (  # noqa: E402,F401
+    WatchdogTimeout,
+    with_watchdog,
+)

@@ -49,9 +49,13 @@ from typing import Any, Dict, List, Optional, Tuple
 DEFAULT_THRESHOLD = 0.10
 
 # substring -> direction; first match wins, checked in order
+# verdict="healthy" counters count GOOD solves; every other verdict label
+# (diverged/stalled/nonfinite/hang/failed) falls through to the
+# lower-is-better default, so a bad verdict appearing from zero trips the
+# gate with change=+inf
 _HIGHER_IS_BETTER = (
     "per_sec", "per_chip", "converged", "mfu", "tflops", "utilization",
-    "throughput",
+    "throughput", 'verdict="healthy"',
 )
 
 
@@ -222,8 +226,19 @@ def compare(
     default_threshold: float = DEFAULT_THRESHOLD,
 ) -> List[dict]:
     """Per-common-metric comparison rows; `regression=True` where NEW is
-    worse than BASELINE by more than the metric's threshold."""
+    worse than BASELINE by more than the metric's threshold.
+
+    Health verdict counters (`solve_verdict_total{...}`) are zero-seeded on
+    whichever side lacks them: counters only exist once bumped, so a clean
+    baseline has no `verdict="diverged"` series at all — without the seed, a
+    bad verdict APPEARING in NEW would silently drop out of the common-metric
+    intersection instead of tripping the appearing-from-zero gate."""
     overrides = overrides or []
+    base, new = dict(base), dict(new)
+    for metric in set(base) | set(new):
+        if "solve_verdict_total" in metric:
+            base.setdefault(metric, 0.0)
+            new.setdefault(metric, 0.0)
     rows: List[dict] = []
     for metric in sorted(set(base) & set(new)):
         b, n = base[metric], new[metric]
@@ -322,6 +337,56 @@ def self_check(out=sys.stdout) -> int:
     rows = compare(zero, {**zero, "retrace_total": 3.0})
     checks.append(("retraces appearing from zero fail",
                    True, any(r["regression"] for r in rows)))
+
+    # solver-health verdict counters (obs.health -> solve_verdict_total):
+    # bad verdicts are lower-is-better and gate on appearing-from-zero;
+    # healthy verdicts are higher-is-better so MORE healthy solves pass
+    vbase = {
+        'metric/solve_verdict_total{solve="solve_lp",verdict="healthy"}': 8.0,
+        'metric/solve_verdict_total{solve="solve_lp",verdict="diverged"}': 0.0,
+        'metric/solve_verdict_total{solve="solve_lp",verdict="stalled"}': 0.0,
+        'metric/solve_verdict_total{solve="solve_lp",verdict="nonfinite"}': 0.0,
+    }
+
+    def vrun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(vbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    vrun("identical verdict counters pass", dict(vbase), False)
+    vrun("diverged verdict appearing from zero fails",
+         {**vbase,
+          'metric/solve_verdict_total{solve="solve_lp",verdict="diverged"}':
+          2.0}, True)
+    vrun("stalled verdict appearing from zero fails",
+         {**vbase,
+          'metric/solve_verdict_total{solve="solve_lp",verdict="stalled"}':
+          1.0}, True)
+    vrun("nonfinite verdict appearing from zero fails",
+         {**vbase,
+          'metric/solve_verdict_total{solve="solve_lp",verdict="nonfinite"}':
+          1.0}, True)
+    vrun("more healthy solves pass (higher is better)",
+         {**vbase,
+          'metric/solve_verdict_total{solve="solve_lp",verdict="healthy"}':
+          16.0}, False)
+    vrun("healthy count dropping >10% fails",
+         {**vbase,
+          'metric/solve_verdict_total{solve="solve_lp",verdict="healthy"}':
+          4.0}, True)
+    # counters only exist once bumped: a bad verdict ABSENT from the
+    # baseline must still gate (zero-seeded), a healthy counter appearing
+    # must not
+    clean = {k: v for k, v in vbase.items() if 'verdict="healthy"' in k}
+    vrun2 = lambda name, new, expect: checks.append(
+        (name, expect, any(r["regression"] for r in compare(clean, new))))
+    vrun2("diverged verdict absent from baseline still fails",
+          {**clean,
+           'metric/solve_verdict_total{solve="solve_lp",verdict="diverged"}':
+           1.0}, True)
+    vrun2("healthy verdict appearing from nothing passes",
+          {**clean,
+           'metric/solve_verdict_total{solve="solve_nlp",verdict="healthy"}':
+           4.0}, False)
 
     ok = True
     for name, want, got in checks:

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Replay a flight-recorder capture: reload the exact problem instance and
+rerun the exact solver entry point, then compare status and final iterate
+bitwise against what the capture observed.
+
+    python tools/replay_solve.py RECORD_DIR/cap-000001-solve_lp
+    python tools/replay_solve.py RECORD_DIR --last          # newest capture
+    python tools/replay_solve.py --self-check               # CI smoke
+
+Exit codes: 0 = reproduced bitwise, 1 = mismatch (the failure is
+environment- or state-dependent — that itself is the finding), 2 = error,
+3 = capture not replayable (BandedLP needs its static meta, NLP its
+callables; those captures are for offline analysis, not replay).
+
+The replay honours the captured precision manifest (x64 on/off) before
+touching jax, because an f64 capture replayed under f32 would "mismatch"
+for dtype reasons, not solver reasons.
+"""
+import argparse
+import inspect
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# tolerate running on hosts without a TPU tunnel; the capture's own
+# JAX_PLATFORMS (if any) still wins below because setdefault won't override
+# an explicit environment
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+RC_OK, RC_MISMATCH, RC_ERROR, RC_NOT_REPLAYABLE = 0, 1, 2, 3
+
+_SOLVERS = ("solve_lp", "solve_lp_pdhg")
+
+
+def _find_capture(path, last=False):
+    if os.path.isfile(os.path.join(path, "meta.json")):
+        return path
+    caps = sorted(
+        os.path.join(path, n)
+        for n in os.listdir(path)
+        if n.startswith("cap-")
+        and os.path.isfile(os.path.join(path, n, "meta.json"))
+    )
+    if not caps:
+        raise FileNotFoundError(f"no captures under {path}")
+    if not last and len(caps) > 1:
+        print(f"replay: {len(caps)} captures, using newest (pass the "
+              "capture dir to pick one)", file=sys.stderr)
+    return caps[-1]
+
+
+def _apply_precision(meta):
+    x64 = (meta.get("manifest") or {}).get("precision", {}).get(
+        "jax_enable_x64"
+    )
+    if x64 is not None:
+        import jax
+
+        jax.config.update("jax_enable_x64", bool(x64))
+
+
+def _filtered_options(fn, options):
+    sig = inspect.signature(fn)
+    opts = {k: v for k, v in (options or {}).items() if k in sig.parameters}
+    opts.pop("trace", None)  # replay compares solutions, not traces
+    dropped = sorted(set(options or {}) - set(opts) - {"trace"})
+    if dropped:
+        print(f"replay: dropping unknown options {dropped}", file=sys.stderr)
+    return opts
+
+
+def replay(capture_path):
+    """Rerun one capture; returns (rc, report dict)."""
+    with open(os.path.join(capture_path, "meta.json"), encoding="utf-8") as fh:
+        meta = json.load(fh)
+    solver = meta.get("solver")
+    if solver not in _SOLVERS or not meta.get("problem_type"):
+        return RC_NOT_REPLAYABLE, {
+            "capture": capture_path,
+            "solver": solver,
+            "error": "capture is archival-only (no replayable problem "
+            "pytree: banded solves need static meta, NLP its callables)",
+        }
+    _apply_precision(meta)
+
+    import numpy as np
+
+    from dispatches_tpu.obs.recorder import load_capture
+
+    cap = load_capture(capture_path)
+    problem = cap["problem"]
+    if problem is None or not hasattr(problem, "_fields"):
+        return RC_NOT_REPLAYABLE, {
+            "capture": capture_path,
+            "solver": solver,
+            "error": f"cannot rebuild problem type {meta['problem_type']!r}",
+        }
+
+    if solver == "solve_lp":
+        from dispatches_tpu.solvers.ipm import solve_lp as entry
+    else:
+        from dispatches_tpu.solvers.pdhg import solve_lp_pdhg as entry
+    opts = _filtered_options(entry, meta.get("options"))
+    sol = entry(problem, **opts)
+
+    recorded = cap["solution"]
+    report = {
+        "capture": capture_path,
+        "solver": solver,
+        "options": opts,
+        "verdict_at_capture": meta.get("verdict"),
+        "fields": {},
+    }
+    bitwise = True
+    for f in sol._fields:
+        new = np.asarray(getattr(sol, f))
+        if f not in recorded:
+            continue
+        same = new.dtype == recorded[f].dtype and np.array_equal(
+            new, recorded[f], equal_nan=True
+        )
+        report["fields"][f] = bool(same)
+        bitwise = bitwise and same
+    report["bitwise"] = bitwise
+    report["status"] = {
+        "recorded": recorded.get("status", recorded.get("converged")),
+        "replayed": getattr(sol, "status", getattr(sol, "converged", None)),
+    }
+    for k in ("recorded", "replayed"):
+        v = report["status"][k]
+        if v is not None:
+            report["status"][k] = np.asarray(v).tolist()
+    return (RC_OK if bitwise else RC_MISMATCH), report
+
+
+def self_check():
+    """CI smoke: synthesize a diverging LP, verify the health engine flags
+    it, capture it, replay it, and require a bitwise reproduction."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from dispatches_tpu.core.program import LPData
+    from dispatches_tpu.obs.health import classify_trace
+    from dispatches_tpu.obs.recorder import FlightRecorder
+    from dispatches_tpu.solvers.ipm import solve_lp
+
+    # min -(x1+x2)  s.t.  x1 - x2 = 0,  x >= 0: unbounded below, so the
+    # IPM cannot converge — the canonical "solver breaks" fixture
+    lp = LPData(
+        A=np.array([[1.0, -1.0]]),
+        b=np.array([0.0]),
+        c=np.array([-1.0, -1.0]),
+        l=np.array([0.0, 0.0]),
+        u=np.array([np.inf, np.inf]),
+        c0=0.0,
+    )
+    options = dict(tol=1e-8, max_iter=30)
+    sol, tr = solve_lp(lp, trace=True, **options)
+    verdict = classify_trace(tr, sol=sol)[0]
+    assert verdict.verdict != "healthy", (
+        f"self-check fixture unexpectedly healthy: {verdict}"
+    )
+    print(f"self-check: fixture verdict = {verdict.verdict} "
+          f"(first bad iter {verdict.first_bad_iteration}, "
+          f"quantity {verdict.quantity})")
+
+    tmp = tempfile.mkdtemp(prefix="replay-selfcheck-")
+    try:
+        rec = FlightRecorder(tmp)
+        cap_path = rec.capture(
+            "solve_lp", problem=lp, options=options, verdict=verdict,
+            solution=sol,
+        )
+        assert cap_path, "capture failed"
+        rc, report = replay(cap_path)
+        print(json.dumps(report, indent=1, default=str))
+        assert rc == RC_OK, f"replay not bitwise (rc={rc})"
+        # archival-only captures must be refused, not mis-replayed
+        rec2 = FlightRecorder(tmp)
+        arch = rec2.capture("solve_nlp", arrays={"x0": np.zeros(3)})
+        rc2, _ = replay(arch)
+        assert rc2 == RC_NOT_REPLAYABLE, rc2
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("self-check: OK (capture -> replay reproduced bitwise)")
+    return RC_OK
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("capture", nargs="?",
+                    help="capture dir (cap-*/) or a --record-failures dir")
+    ap.add_argument("--last", action="store_true",
+                    help="with a record dir: replay the newest capture")
+    ap.add_argument("--self-check", action="store_true",
+                    help="synthetic capture->replay round trip (CI smoke)")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if not args.capture:
+        ap.error("capture path required (or --self-check)")
+    try:
+        cap = _find_capture(args.capture, last=args.last)
+        rc, report = replay(cap)
+    except Exception as exc:  # noqa: BLE001 - CLI boundary
+        print(f"replay: error: {type(exc).__name__}: {exc}", file=sys.stderr)
+        return RC_ERROR
+    print(json.dumps(report, indent=1, default=str))
+    if rc == RC_OK:
+        print("replay: reproduced bitwise")
+    elif rc == RC_MISMATCH:
+        bad = [f for f, ok in report.get("fields", {}).items() if not ok]
+        print(f"replay: MISMATCH in fields {bad}", file=sys.stderr)
+    else:
+        print(f"replay: {report.get('error')}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,7 @@ from dispatches_tpu.market.network import (  # noqa: E402
     ProductionCostSimulator,
     synthesize_network,
 )
+from dispatches_tpu.obs.watchdog import with_watchdog  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(__file__), "..", "NETWORK_YEAR.json")
 
@@ -106,8 +107,15 @@ def main(days: int = 365, n_buses: int = 73, n_units: int = None) -> dict:
         return out
 
     holder = {}
-    sim.simulate(
-        days, progress=lambda d, rows: holder.update(summarize(d, rows))
+    # hang guard (obs.watchdog): generous whole-run backstop — progress
+    # flushes NETWORK_YEAR.json per day, so an abandoned hung run still
+    # leaves a valid partial artifact
+    with_watchdog(
+        lambda: sim.simulate(
+            days, progress=lambda d, rows: holder.update(summarize(d, rows))
+        ),
+        timeout_s=max(1800.0, days * 120.0),
+        stage=f"network_year {days}d",
     )
     return holder
 

@@ -26,6 +26,7 @@ from dispatches_tpu.market.network import (  # noqa: E402
     solve_uc_milp_sparse,
     synthesize_fleet,
 )
+from dispatches_tpu.obs.watchdog import with_watchdog  # noqa: E402
 
 
 def main():
@@ -36,15 +37,27 @@ def main():
         loads = g.da_load[:48].sum(1)
         ren = g.da_renewables[:48].sum(1)
         t0 = time.time()
-        cand = ouc.commit(loads, ren, improve_rounds=2)
+        # hang guard (obs.watchdog): the commit path touches the device;
+        # a wedged backend must fail this row, not hang the sweep forever
+        cand = with_watchdog(
+            lambda: ouc.commit(loads, ren, improve_rounds=2),
+            timeout_s=1800.0,
+            stage=f"uc commit n={n}",
+        )
         t_commit = time.time() - t0
         cost, ok = ouc._evaluate(cand[None], loads, ren)
         t0 = time.time()
-        milp = solve_uc_milp_sparse(
-            ouc.prog,
-            {"load_total": loads, "ren_total": ren},
-            time_limit=900,
-            mip_rel_gap=1e-5,
+        # MILP time_limit=900 bounds HiGHS itself; the watchdog bounds a
+        # hang outside the solver (model build, a stuck host thread)
+        milp = with_watchdog(
+            lambda: solve_uc_milp_sparse(
+                ouc.prog,
+                {"load_total": loads, "ren_total": ren},
+                time_limit=900,
+                mip_rel_gap=1e-5,
+            ),
+            timeout_s=1200.0,
+            stage=f"uc milp n={n}",
         )
         rows.append(
             {

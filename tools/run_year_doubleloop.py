@@ -43,6 +43,7 @@ from dispatches_tpu.market.network import (  # noqa: E402
     load_rts_format,
 )
 from dispatches_tpu.market.tracker import Tracker  # noqa: E402
+from dispatches_tpu.obs.watchdog import with_watchdog  # noqa: E402
 
 GEN = "309_WIND_1"
 
@@ -91,7 +92,13 @@ def main(days: int = 365) -> dict:
     coordinator = DoubleLoopCoordinator(bidder, tracker)
 
     sim = ProductionCostSimulator(grid, participant_segments=2)
-    rows = sim.simulate(days, coordinator=coordinator)
+    # hang guard (obs.watchdog): a wedged backend mid-year must raise (and
+    # journal a `hang` verdict) instead of blocking the run forever
+    rows = with_watchdog(
+        lambda: sim.simulate(days, coordinator=coordinator),
+        timeout_s=max(1800.0, days * 120.0),
+        stage=f"year_doubleloop {days}d",
+    )
     wall = time.time() - t0
 
     conv = np.array([r["SCED Converged"] for r in rows])

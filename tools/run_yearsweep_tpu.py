@@ -43,6 +43,10 @@ from bench import (  # noqa: E402  (bench.py lives at the repo root)
     _run_year_batch_via_child,
     _sweep_stale_tmps,
 )
+from dispatches_tpu.obs.watchdog import (  # noqa: E402
+    WatchdogTimeout,
+    with_watchdog,
+)
 
 
 def main():
@@ -123,9 +127,19 @@ def main():
         # a ~1e-5 anti-memoization jitter to the scales it was handed and
         # reports scales_used; NPVs are recorded against scales_used.
         t0 = time.perf_counter()
-        cres = _run_year_batch_via_child(
-            ylmp, ycf, len(idx), scales=scales[idx]
-        )
+        try:
+            # hang backstop OUTSIDE the child's own ~2700 s fallback budget:
+            # if the child orchestration itself wedges (stuck tunnel read in
+            # the parent), the chunk is abandoned and the loop moves on
+            cres = with_watchdog(
+                lambda: _run_year_batch_via_child(
+                    ylmp, ycf, len(idx), scales=scales[idx]
+                ),
+                timeout_s=3300.0,
+                stage=f"yearsweep chunk {idx[0]}..{idx[-1]}",
+            )
+        except WatchdogTimeout as e:
+            cres = {"failed": True, "fallback_errors": [str(e)]}
         if cres.get("failed"):
             rec["chunks"].append(
                 {"chunk": idx, "failed": True,

@@ -11,9 +11,13 @@ manifest record). For each run this prints:
 - the span tree with wall-clock seconds, ok/FAIL, and the per-span
   retrace deltas the Tracer recorded;
 - every solve record: batch size, converged fraction, the iteration
-  histogram `batch_stats` embedded at record time, and — when a
-  SolveTrace rode along — recorded-iteration range plus divergent-element
-  flags (`trace_stats`);
+  histogram `batch_stats` embedded at record time, the `obs.health`
+  verdict (worst lane + first-bad-iteration when non-healthy), and — when
+  a SolveTrace rode along — recorded-iteration range plus
+  divergent-element flags (`trace_stats`);
+- a run-level health footer: counts per verdict across all solve records
+  (plus `hang` watchdog events and sweep point verdicts) and the worst
+  offender span;
 - cumulative retrace counts from the close record (or summed span deltas
   for a run that died before closing).
 
@@ -67,6 +71,38 @@ def _split_runs(events: List[dict]) -> List[List[dict]]:
     if cur:
         runs.append(cur)
     return runs
+
+
+# verdict badness order, mirrored from obs.health.SEVERITY (kept local so
+# summarizing a journal never needs to import jax-adjacent packages)
+_SEVERITY = (
+    "healthy", "slow", "cycling", "stalled", "diverged", "nonfinite",
+    "hang", "failed",
+)
+
+
+def _severity(verdict: str) -> int:
+    try:
+        return _SEVERITY.index(verdict)
+    except ValueError:
+        return len(_SEVERITY)
+
+
+def _fmt_verdict(health: dict) -> str:
+    """One-token verdict column for a solve line, with provenance when bad:
+    `verdict=diverged[lane 3 @ iter 12 gap]`."""
+    worst = health.get("worst") or {}
+    v = worst.get("verdict", "?")
+    if v == "healthy":
+        return " verdict=healthy"
+    bits = []
+    if worst.get("lane") is not None:
+        bits.append(f"lane {worst['lane']}")
+    if worst.get("first_bad_iteration") is not None:
+        bits.append(f"@ iter {worst['first_bad_iteration']}")
+    if worst.get("quantity"):
+        bits.append(str(worst["quantity"]))
+    return f" verdict={v}[{' '.join(bits)}]" if bits else f" verdict={v}"
 
 
 def _fmt_retraces(delta: dict) -> str:
@@ -143,6 +179,9 @@ def _print_solves(run: List[dict], out) -> None:
         )
         if stats.get("nonfinite_count"):
             line += f" nonfinite={stats['nonfinite_count']}"
+        health = ev.get("health")
+        if isinstance(health, dict):
+            line += _fmt_verdict(health)
         print(line, file=out)
         if it.get("hist"):
             print(f"      hist: {_fmt_hist(it['hist'])}", file=out)
@@ -171,6 +210,56 @@ def _print_solves(run: List[dict], out) -> None:
                 print(f"      cost: {' '.join(parts)}", file=out)
 
 
+def _print_health_footer(run: List[dict], out) -> None:
+    """Run-level verdict aggregate: counts per verdict across solve-record
+    health summaries, watchdog `hang` events, and sweep point verdicts,
+    plus the worst offender span. Silent when nothing carried a verdict
+    (pre-health journals stay rendered exactly as before)."""
+    counts: dict = {}
+    worst = None  # (severity, span/name, worst-dict)
+    for ev in run:
+        if ev.get("kind") == "solve" and isinstance(ev.get("health"), dict):
+            for v, n in (ev["health"].get("counts") or {}).items():
+                if isinstance(n, (int, float)):
+                    counts[v] = counts.get(v, 0) + int(n)
+            w = ev["health"].get("worst") or {}
+            sev = _severity(w.get("verdict", "healthy"))
+            if sev > 0 and (worst is None or sev > worst[0]):
+                worst = (sev, ev.get("span") or ev.get("name", "?"), w)
+        elif ev.get("kind") == "event":
+            if ev.get("name") == "capture":
+                continue  # echoes a verdict already counted at its solve
+            v = None
+            if ev.get("name") == "hang":
+                v = "hang"
+            elif isinstance(ev.get("verdict"), str):
+                v = ev["verdict"]
+            if v:
+                counts[v] = counts.get(v, 0) + 1
+                sev = _severity(v)
+                if sev > 0 and (worst is None or sev > worst[0]):
+                    worst = (
+                        sev,
+                        ev.get("span") or ev.get("stage") or ev.get("name", "?"),
+                        {"verdict": v},
+                    )
+    if not counts:
+        return
+    txt = ", ".join(
+        f"{v}={counts[v]}"
+        for v in sorted(counts, key=_severity, reverse=True)
+    )
+    print(f"  health: {txt}", file=out)
+    if worst is not None:
+        _, where, w = worst
+        bits = [w.get("verdict", "?")]
+        if w.get("first_bad_iteration") is not None:
+            bits.append(f"first bad iter {w['first_bad_iteration']}")
+        if w.get("quantity"):
+            bits.append(str(w["quantity"]))
+        print(f"  worst offender: {where} ({', '.join(bits)})", file=out)
+
+
 def _print_run(run: List[dict], out, max_spans: int) -> None:
     man = next((e for e in run if e.get("kind") == "manifest"), {})
     sha = (man.get("git_sha") or "?")[:12]
@@ -185,6 +274,7 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
     )
     _print_spans(run, out, max_spans)
     _print_solves(run, out)
+    _print_health_footer(run, out)
     close = next((e for e in run if e.get("kind") == "close"), None)
     if close is not None:
         totals = close.get("retrace_totals", {})
