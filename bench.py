@@ -559,6 +559,14 @@ def main():
     if _FORCE_CPU:
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+    # persistent compile cache (no-op unless DISPATCHES_TPU_CACHE_DIR is
+    # set): a re-launched bench skips recompiling the weekly/year/ladder
+    # executables entirely — set BEFORE any compile below
+    from dispatches_tpu.runtime import enable_persistent_cache
+
+    cache_dir = enable_persistent_cache()
+    if cache_dir:
+        _LOCAL["compile_cache_dir"] = cache_dir
     global _PROFILE_CM
     if _PROFILE_DIR and _PROFILE_CM is None:
         from dispatches_tpu.obs import profile_capture
@@ -769,7 +777,197 @@ def main():
     _LOCAL["rows"]["weekly"]["rel_err_vs_highs"] = rel_err
     _LOCAL["rows"]["weekly"]["cpu_highs_solves_per_sec"] = cpu_solves_per_sec
     _flush_local()
-    _journal().event("row", name="weekly", **_LOCAL["rows"]["weekly"])
+    _journal().event("row", row="weekly", **_LOCAL["rows"]["weekly"])
+
+    # ------------------------------------------------------------------
+    # Adaptive-batching rows (runtime/adaptive.py): iteration-count wins
+    # from neighbor warm starts on the weekly batch and the battery-ratio
+    # sweep, and the retirement-heavy wall-clock comparison. Totals land
+    # in BENCH_DIAG.json under "adaptive" (and as rows in BENCH_LOCAL).
+    from dispatches_tpu.obs import metrics as obs_metrics
+    from dispatches_tpu.runtime import (
+        solve_lp_adaptive,
+        warmup_ladder,
+    )
+    from dispatches_tpu.solvers.ipm import solve_lp_batch
+
+    wkw = dict(tol=tol, max_iter=60, refine_steps=2, stall_limit=10)
+    inst32 = jax.vmap(
+        lambda lm, cf: prog.instantiate(
+            {"lmp": lm, "wind_cf": cf}, dtype=jnp.float32
+        )
+    )
+
+    def _weekly_warmstart():
+        # solve a batch, then its NEIGHBOR batch (same weeks, nearby
+        # scenario scale) cold vs warm-seeded from the first solutions —
+        # the sweep-chunk seeding pattern of run_year_sweep
+        nb = min(8 if smoke else 16, B)
+        lp_a = inst32(jnp.asarray(lmps_used[:nb]), jnp.asarray(cfs[:nb]))
+        sol_a = solve_lp_batch(lp_a, **wkw)
+        lp_n = inst32(
+            jnp.asarray(lmps_used[:nb] * np.float32(1.03)),
+            jnp.asarray(cfs[:nb]),
+        )
+        sol_cold = solve_lp_batch(lp_n, **wkw)
+        seeds = (sol_a.x, sol_a.y, sol_a.zl, sol_a.zu)
+        sol_warm = solve_lp_batch(lp_n, warm_start=seeds, **wkw)
+        return (
+            np.asarray(sol_cold.iterations), np.asarray(sol_warm.iterations),
+            bool(np.asarray(sol_cold.converged).all()
+                 and np.asarray(sol_warm.converged).all()),
+        )
+
+    it_cold, it_warm, ws_conv = _device(
+        "weekly warm-start iters", _weekly_warmstart
+    )
+    ws_saved = int(it_cold.sum() - it_warm.sum())
+    if ws_saved > 0:
+        obs_metrics.inc("warm_start_iters_saved_total", ws_saved,
+                        runner="bench_weekly")
+    _LOCAL["rows"]["weekly_warmstart"] = {
+        "lanes": int(it_cold.shape[0]),
+        "iters_cold": [int(v) for v in it_cold],
+        "iters_warm": [int(v) for v in it_warm],
+        "iters_cold_total": int(it_cold.sum()),
+        "iters_warm_total": int(it_warm.sum()),
+        "iters_saved_total": ws_saved,
+        "converged": ws_conv,
+    }
+    _DIAG.setdefault("adaptive", {})["weekly_warmstart"] = {
+        "iters_cold_total": int(it_cold.sum()),
+        "iters_warm_total": int(it_warm.sum()),
+        "iters_saved_total": ws_saved,
+    }
+    _atomic_dump(_DIAG, _DIAG_PATH)
+    _flush_local()
+    _journal().event(
+        "row", row="weekly_warmstart", **_LOCAL["rows"]["weekly_warmstart"]
+    )
+
+    def _battsweep_warmstart():
+        # battery-ratio sweep (reference `run_pricetaker_battery_ratio_
+        # size.py` axis): fixed-size LPs share one shape across ratios, so
+        # point i warm-starts from point i-1's solution — the sequential
+        # sweep seeding pattern (f64: the sweep contract regime)
+        ratios = (0.25, 0.5, 0.75) if smoke else (0.2, 0.4, 0.6, 0.8, 1.0)
+        recs = []
+        prev = None
+        for rho in ratios:
+            d = HybridDesign(
+                T=T,
+                with_battery=True,
+                batt_mw=rho * P.FIXED_WIND_MW,
+                design_opt=False,
+                initial_soc_fixed=0.0,
+            )
+            pr, _ = build_pricetaker(d)
+            lp = pr.instantiate({
+                "lmp": jnp.asarray(lmp_weeks[0], jnp.float64),
+                "wind_cf": jnp.asarray(cf_weeks[0], jnp.float64),
+            })
+            sc = solve_lp(lp, tol=tol, max_iter=60)
+            sw = sc if prev is None else solve_lp(
+                lp, tol=tol, max_iter=60, warm_start=prev
+            )
+            recs.append((
+                rho, int(np.asarray(sc.iterations)),
+                int(np.asarray(sw.iterations)),
+                bool(np.asarray(sc.converged) and np.asarray(sw.converged)),
+            ))
+            prev = (sw.x, sw.y, sw.zl, sw.zu)
+        return recs
+
+    bt = _device("battsweep warm-start iters", _battsweep_warmstart)
+    bt_cold = sum(r[1] for r in bt)
+    bt_warm = sum(r[2] for r in bt)
+    if bt_cold > bt_warm:
+        obs_metrics.inc("warm_start_iters_saved_total", bt_cold - bt_warm,
+                        runner="bench_battsweep")
+    _LOCAL["rows"]["battsweep_warmstart"] = {
+        "points": [
+            {"ratio": r[0], "iters_cold": r[1], "iters_warm": r[2],
+             "converged": r[3]} for r in bt
+        ],
+        "iters_cold_total": bt_cold,
+        "iters_warm_total": bt_warm,
+        "iters_saved_total": bt_cold - bt_warm,
+    }
+    _DIAG["adaptive"]["battsweep_warmstart"] = {
+        "iters_cold_total": bt_cold,
+        "iters_warm_total": bt_warm,
+        "iters_saved_total": bt_cold - bt_warm,
+    }
+    _atomic_dump(_DIAG, _DIAG_PATH)
+    _flush_local()
+    _journal().event(
+        "row", row="battsweep_warmstart",
+        **_LOCAL["rows"]["battsweep_warmstart"],
+    )
+
+    def _adaptive_retirement():
+        # retirement-heavy batch: warm lanes converge in ~2 iterations,
+        # NaN-seeded lanes reject the seed and run cold — a ~10x per-lane
+        # iteration spread, the regime compaction is built for. The
+        # ladder executables are AOT-warmed so neither timed path
+        # compiles; the fixed path is warmed by the solve above.
+        nb = min(8 if smoke else 16, B)
+        n_slow = max(2, nb // 4)
+        lp_b = inst32(jnp.asarray(lmps_used[:nb]), jnp.asarray(cfs[:nb]))
+        sol0 = solve_lp_batch(lp_b, **wkw)
+        seeds = [np.asarray(a).copy()
+                 for a in (sol0.x, sol0.y, sol0.zl, sol0.zu)]
+        for a in seeds:
+            a[-n_slow:] = np.nan  # rejected wholesale -> cold lanes
+        seeds = tuple(jnp.asarray(a) for a in seeds)
+        warmup_ladder(lp_b, chunk_iters=4, ladder_base=4, **wkw)
+        _fixed = jax.jit(
+            jax.vmap(
+                lambda d, w: solve_lp(d, warm_start=w, **wkw),
+                in_axes=(jax.tree.map(lambda _: 0, lp_b), 0),
+            )
+        )
+        np.asarray(_fixed(lp_b, seeds).x)  # warm the fixed executable
+        t0 = time.perf_counter()
+        sol_f = _fixed(lp_b, seeds)
+        np.asarray(sol_f.x)
+        dt_fixed = time.perf_counter() - t0
+        st = {}
+        t0 = time.perf_counter()
+        sol_ad = solve_lp_adaptive(
+            lp_b, chunk_iters=4, ladder_base=4, warm_start=seeds,
+            stats=st, **wkw
+        )
+        np.asarray(sol_ad.x)
+        dt_ad = time.perf_counter() - t0
+        its = np.asarray(sol_ad.iterations)
+        return {
+            "lanes": nb,
+            "slow_lanes": n_slow,
+            "iters_min": int(its.min()),
+            "iters_max": int(its.max()),
+            "seconds_fixed": round(dt_fixed, 4),
+            "seconds_adaptive": round(dt_ad, 4),
+            "speedup": round(dt_fixed / max(dt_ad, 1e-9), 3),
+            "lanes_retired": st.get("lanes_retired"),
+            "buckets": st.get("buckets"),
+            "converged": bool(np.asarray(sol_ad.converged).all()),
+            "obj_match_fixed": bool(
+                np.allclose(np.asarray(sol_f.obj), np.asarray(sol_ad.obj),
+                            rtol=1e-5, atol=1e-5)
+            ),
+        }
+
+    ad_row = _device("adaptive retirement batch", _adaptive_retirement)
+    _LOCAL["rows"]["adaptive_retirement"] = ad_row
+    _DIAG["adaptive"]["retirement"] = {
+        k: ad_row[k]
+        for k in ("seconds_fixed", "seconds_adaptive", "speedup",
+                  "lanes_retired")
+    }
+    _atomic_dump(_DIAG, _DIAG_PATH)
+    _flush_local()
+    _journal().event("row", row="adaptive_retirement", **ad_row)
 
     # ------------------------------------------------------------------
     # Year rows: the 8,760-h design LP via the block-tridiagonal IPM
@@ -873,7 +1071,7 @@ def main():
             ycost = {"error": f"{type(e).__name__}: {e}"}
         _LOCAL["rows"]["year_single"]["cost"] = ycost
     _flush_local()
-    _journal().event("row", name="year_single", **_LOCAL["rows"]["year_single"])
+    _journal().event("row", row="year_single", **_LOCAL["rows"]["year_single"])
 
     # scenario-batched year row (north-star axis): By simultaneous 8,760-h
     # design LPs, shared banded structure, per-scenario LMP draws, one vmap
@@ -930,7 +1128,7 @@ def main():
             "year-batch row FAILED in child process (worker crash/timeout; "
             "see BENCH_LOCAL.json fallback_errors)"
         )
-    _journal().event("row", name="year_batch", **_LOCAL["rows"]["year_batch"])
+    _journal().event("row", row="year_batch", **_LOCAL["rows"]["year_batch"])
 
     result = {
         "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "

@@ -75,6 +75,11 @@ def solve_lp_sharded(
     computation is one jit-compiled program — XLA partitions the batch and
     runs per-chip vmapped IPM solves with no cross-chip traffic inside the
     iteration loop.
+
+    A batch that does not divide the device count is edge-replicated up to
+    the next multiple with `pad_batch`; the padded lanes solve copies of
+    the last scenario and are sliced off before returning, so callers see
+    exactly one result row per input scenario.
     """
     base_ndim = {"A": 2, "b": 1, "c": 1, "l": 1, "u": 1, "c0": 0}
     shardings = []
@@ -87,11 +92,13 @@ def solve_lp_sharded(
             shardings.append(NamedSharding(mesh, PSpec()))
     if batch is None:
         raise ValueError("no batched field to shard over")
+    n_orig = batch
     if batch % mesh.devices.size != 0:
-        raise ValueError(
-            f"scenario batch {batch} must divide evenly over "
-            f"{mesh.devices.size} devices (pad the batch)"
-        )
+        lp = LPData(*(
+            pad_batch(a, mesh.devices.size)[0]
+            if a.ndim == base_ndim[n] + 1 else a
+            for n, a in zip(LPData._fields, lp)
+        ))
     lp_sharded = LPData(
         *(jax.device_put(a, s) for a, s in zip(lp, shardings))
     )
@@ -100,7 +107,12 @@ def solve_lp_sharded(
     )
     fn = jax.jit(jax.vmap(lambda d: solve_lp(d, **solver_kw), in_axes=(in_axes,)))
     with mesh:
-        return fn(lp_sharded)
+        out = fn(lp_sharded)
+    if n_orig != out.x.shape[0]:
+        # padded lanes are edge copies of the last scenario: drop them so
+        # results (and any metrics derived from them) cover inputs only
+        out = jax.tree.map(lambda a: a[:n_orig], out)
+    return out
 
 
 def pad_batch(arr: jnp.ndarray, multiple: int, axis: int = 0):

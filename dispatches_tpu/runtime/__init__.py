@@ -1,5 +1,15 @@
-"""Native host runtime: IO, sparse assembly, result-store (csrc bindings)."""
+"""Host runtime: IO, sparse assembly, result-store (csrc bindings), and
+the adaptive batched-solve engine (lane retirement/compaction)."""
 
+from .adaptive import (
+    bucket_ladder,
+    enable_persistent_cache,
+    next_bucket,
+    solve_lp_adaptive,
+    solve_lp_banded_adaptive,
+    solve_lp_pdhg_adaptive,
+    warmup_ladder,
+)
 from .native import (
     ResultStore,
     coo_to_csr,

@@ -74,6 +74,35 @@ class IPMSolution(NamedTuple):
     status: jnp.ndarray  # STATUS_* code (see status_name)
 
 
+class IPMState(NamedTuple):
+    """Opaque resumable loop state for segmented solves (`solve_lp_partial`).
+
+    Everything lives in the solver's INTERNAL scaled frame (Ruiz + norm
+    scaling), which is recomputed deterministically from the LP data on
+    every call — so feeding a state back with the *same* LP resumes the
+    exact iterate sequence, and the chunked solve is bitwise identical to
+    the one-shot solve (the adaptive-batching contract, see
+    `runtime/adaptive.py` and tests/test_zz_adaptive.py). Treat the fields as
+    opaque; only `it` (iterations completed) and `done` (the loop's own
+    stop flag: converged / numerical breakdown / divergence / stall) are
+    meant for host-side retirement decisions.
+    """
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    zl: jnp.ndarray
+    zu: jnp.ndarray
+    best_merit: jnp.ndarray
+    best_x: jnp.ndarray
+    best_y: jnp.ndarray
+    best_zl: jnp.ndarray
+    best_zu: jnp.ndarray
+    best_it: jnp.ndarray
+    it: jnp.ndarray
+    done: jnp.ndarray
+    trace: SolveTrace
+
+
 def _max_step(v, dv, mask):
     """Largest alpha in (0, 1] with v + alpha*dv >= 0 over masked entries."""
     neg = (dv < 0) & mask
@@ -118,6 +147,7 @@ def solve_lp(
     stall_limit: int = None,
     correctors: int = 0,
     trace: bool = False,
+    warm_start=None,
 ) -> IPMSolution:
     """Scale (Ruiz + norm), solve, unscale. See `_solve_scaled` for the core.
 
@@ -137,18 +167,68 @@ def solve_lp(
     return value becomes ``(IPMSolution, SolveTrace)``. Tracing never
     alters the iteration itself — with `trace=False` the solve is bitwise
     identical to the untraced solver.
+
+    `warm_start` (optional ``(x, y, zl, zu)`` in the SOLUTION frame, e.g.
+    the fields of a neighboring sweep point's `IPMSolution`) seeds the
+    iteration instead of the cold starting point, after a safeguarded
+    interior shift; a warm iterate whose shift is too large (it came from
+    a different geometry) is rejected and the solve falls back to the
+    cold start — see `_solve_scaled`. ``warm_start=None`` (the default)
+    is bitwise identical to the pre-warm-start solver.
     """
     # TPU f32 matmuls default to bf16 passes, which destroys the
     # normal-equations Cholesky (round-1 bench: 0/416 converged). Force full
     # f32 accumulation for every dot/cholesky in the solve; no-op on CPU/f64.
     with jax.default_matmul_precision(_MATMUL_PRECISION):
         sol, tr = _solve_lp_inner(
-            lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit, correctors, trace
+            lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit,
+            correctors, trace, warm_start=warm_start,
         )
     return (sol, tr) if trace else sol
 
 
-def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit=None, correctors=0, trace=False):
+@partial(
+    jax.jit,
+    static_argnames=("max_iter", "refine_steps", "stall_limit", "correctors", "trace"),
+)
+def solve_lp_partial(
+    lp: LPData,
+    tol: float = 1e-8,
+    max_iter: int = 60,
+    reg_p: float = None,
+    reg_d: float = None,
+    refine_steps: int = 2,
+    q: jnp.ndarray = None,
+    stall_limit: int = None,
+    correctors: int = 0,
+    trace: bool = False,
+    warm_start=None,
+    state: IPMState = None,
+    it_stop=None,
+):
+    """Segmented solve: run the Mehrotra loop up to iteration ``it_stop``
+    (a traced scalar — chunk boundaries never retrace) and return
+    ``(IPMSolution, IPMState)``. Feed ``state`` back (with the SAME `lp`)
+    to resume exactly where the previous segment stopped; the chunked
+    iterate sequence is bitwise identical to the one-shot `solve_lp`.
+    The returned solution is only final for lanes whose ``state.done`` is
+    set or whose ``state.it`` reached ``max_iter`` — for still-active
+    lanes it reports the best iterate so far. When ``trace=True`` the
+    per-iteration trace rides in ``state.trace`` (indices keep counting
+    across segments, so the stitched trace equals the one-shot trace).
+    This is the engine primitive of `runtime/adaptive.py` (lane
+    retirement + compaction); most callers want that, not this.
+    """
+    with jax.default_matmul_precision(_MATMUL_PRECISION):
+        sol, _tr, st = _solve_lp_inner(
+            lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit,
+            correctors, trace, warm_start=warm_start, state0=state,
+            it_stop=it_stop, return_state=True,
+        )
+    return sol, st
+
+
+def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limit=None, correctors=0, trace=False, warm_start=None, state0=None, it_stop=None, return_state=False):
     note_trace("solve_lp", signature_of(*lp))
     A0, b0, c0v, l0, u0, off0 = lp
     if reg_p is None:
@@ -172,7 +252,19 @@ def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limi
     )
     q0 = jnp.zeros_like(c0v) if q is None else jnp.asarray(q, c0v.dtype)
     q_s = q0 * cs * cs * sig_b / sig_c
-    sol, tr = _solve_scaled(
+    warm_s = None
+    if warm_start is not None:
+        # map the solution-frame warm iterate into the scaled frame (the
+        # inverse of the unscaling below); the interior-shift safeguard
+        # runs inside _solve_scaled where the bounds are at hand
+        xw, yw, zlw, zuw = warm_start
+        warm_s = (
+            xw / (cs * sig_b),
+            yw / (r * sig_c),
+            zlw * cs / sig_c,
+            zuw * cs / sig_c,
+        )
+    out = _solve_scaled(
         LPData(A, b / sig_b, c / sig_c, l / sig_b, u / sig_b, jnp.zeros_like(off0)),
         tol,
         max_iter,
@@ -183,29 +275,34 @@ def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limi
         stall_limit=stall_limit,
         correctors=correctors,
         trace=trace,
+        warm=warm_s,
+        state0=state0,
+        it_stop=it_stop,
+        return_state=return_state,
     )
+    sol, tr = out[:2]
     # unscale: x = cs * x~ * sig_b ; y = sig_c * r * y~ ; z = sig_c/cs * z~
     x = sol.x * cs * sig_b
     y = sol.y * r * sig_c
     zl = sol.zl / cs * sig_c
     zu = sol.zu / cs * sig_c
     obj = c0v @ x + 0.5 * (q0 * x) @ x + off0
-    return (
-        IPMSolution(
-            x=x,
-            y=y,
-            zl=zl,
-            zu=zu,
-            obj=obj,
-            converged=sol.converged,
-            iterations=sol.iterations,
-            res_primal=sol.res_primal,
-            res_dual=sol.res_dual,
-            gap=sol.gap,
-            status=sol.status,
-        ),
-        tr,
+    sol_out = IPMSolution(
+        x=x,
+        y=y,
+        zl=zl,
+        zu=zu,
+        obj=obj,
+        converged=sol.converged,
+        iterations=sol.iterations,
+        res_primal=sol.res_primal,
+        res_dual=sol.res_dual,
+        gap=sol.gap,
+        status=sol.status,
     )
+    if return_state:
+        return sol_out, tr, out[2]
+    return sol_out, tr
 
 
 def _solve_scaled(
@@ -221,6 +318,10 @@ def _solve_scaled(
     stall_limit: int = None,
     correctors: int = 0,
     trace: bool = False,
+    warm: tuple = None,
+    state0: "IPMState" = None,
+    it_stop=None,
+    return_state: bool = False,
 ):
     """Core Mehrotra iteration. Returns ``(IPMSolution, SolveTrace)``; the
     trace holds per-iteration relative residuals/gap/steps when
@@ -238,7 +339,18 @@ def _solve_scaled(
     `d_cap` caps the barrier weight z/x of near-active variables. Long
     banded factorization chains in f32 need it (uncapped spreads reach
     1e12 and break the block Cholesky); the dense path must NOT cap (a
-    cap this tight stalls the duality gap at ~1e-4 on weekly LPs)."""
+    cap this tight stalls the duality gap at ~1e-4 on weekly LPs).
+
+    `warm` = (x, y, zl, zu) in the SCALED frame replaces the cold start
+    after a safeguard: the iterate is clipped strictly interior and the
+    whole warm start is rejected (per lane, under vmap) when clipping had
+    to shift any coordinate by more than 10% of its bound range — an
+    infeasible-shifted seed costs more iterations than a cold start.
+    `state0` resumes a previous segment's loop carry verbatim; `it_stop`
+    (traced) halts the loop at that iteration count so a host-side driver
+    can retire/compact lanes between segments; `return_state` additionally
+    returns the raw `IPMState` carry. With all four at their defaults the
+    loop is bit-for-bit the historical one."""
     A, b, c, l, u, c0 = lp
     dtype = b.dtype
     q = jnp.zeros_like(c) if q is None else q
@@ -284,6 +396,35 @@ def _solve_scaled(
     z0l = jnp.where(fl, 1.0, 0.0).astype(dtype)
     z0u = jnp.where(fu, 1.0, 0.0).astype(dtype)
 
+    if warm is not None:
+        # Safeguarded warm start: clip the seed strictly interior, then
+        # reject it wholesale if clipping moved any coordinate by more
+        # than 10% of its bound range (relative for one-sided bounds) or
+        # the seed is nonfinite — such a shift means the neighbor's
+        # active set disagrees and the cold start converges faster.
+        xw, yw, zlw, zuw = (jnp.asarray(a, dtype) for a in warm)
+        width = u_s - l_s
+        marg = jnp.where(both, jnp.minimum(1e-4, 0.25 * width), 1e-4)
+        lo = jnp.where(fl, l_s + marg, -jnp.inf)
+        hi = jnp.where(fu, u_s - marg, jnp.inf)
+        x_w = jnp.clip(xw, lo, hi)
+        z_floor = jnp.asarray(1e-4, dtype)
+        zl_w = jnp.where(fl, jnp.maximum(zlw, z_floor), 0.0)
+        zu_w = jnp.where(fu, jnp.maximum(zuw, z_floor), 0.0)
+        denom = jnp.where(both, jnp.maximum(width, 1e-8), 1.0 + jnp.abs(xw))
+        shifted = jnp.where(fl | fu, jnp.abs(x_w - xw) / denom, 0.0)
+        finite_w = (
+            jnp.all(jnp.isfinite(xw))
+            & jnp.all(jnp.isfinite(yw))
+            & jnp.all(jnp.isfinite(zl_w))
+            & jnp.all(jnp.isfinite(zu_w))
+        )
+        ok_w = finite_w & (jnp.max(shifted, initial=0.0) <= 0.1)
+        x0 = jnp.where(ok_w, x_w, x0)
+        y0 = jnp.where(ok_w, yw, y0)
+        z0l = jnp.where(ok_w, zl_w, z0l)
+        z0u = jnp.where(ok_w, zu_w, z0u)
+
     def residuals(x, y, zl, zu):
         rp = b - matvec(x)
         rd = c + q * x - rmatvec(y) - zl + zu
@@ -300,9 +441,18 @@ def _solve_scaled(
             comp / (1.0 + jnp.abs(c @ x)),
         )
 
-    def cond(state):
-        x, y, zl, zu, best, it, done, tr = state
-        return (it < max_iter) & (~done)
+    if it_stop is None:
+        def cond(state):
+            x, y, zl, zu, best, it, done, tr = state
+            return (it < max_iter) & (~done)
+    else:
+        # traced stop mark: the same executable serves every segment
+        # boundary, so host-side compaction never triggers a retrace
+        it_cap = jnp.minimum(jnp.asarray(it_stop), max_iter)
+
+        def cond(state):
+            x, y, zl, zu, best, it, done, tr = state
+            return (it < it_cap) & (~done)
 
     def body(state):
         x, y, zl, zu, best, it, _, tr = state
@@ -473,15 +623,33 @@ def _solve_scaled(
             )
         return (x_n, y_n, zl_n, zu_n, best, it + 1, done, tr)
 
-    rp0, rd0, comp0 = residuals(x0, y0, z0l, z0u)
-    best0 = (
-        merit_of(rp0, rd0, comp0, x0), x0, y0, z0l, z0u, jnp.array(0)
-    )
-    tr0 = _empty_trace(max_iter if trace else 0, dtype)
-    state = lax.while_loop(
-        cond, body, (x0, y0, z0l, z0u, best0, jnp.array(0), jnp.array(False), tr0)
-    )
-    _, _, _, _, best, it, done, tr_out = state
+    if state0 is None:
+        rp0, rd0, comp0 = residuals(x0, y0, z0l, z0u)
+        best0 = (
+            merit_of(rp0, rd0, comp0, x0), x0, y0, z0l, z0u, jnp.array(0)
+        )
+        tr0 = _empty_trace(max_iter if trace else 0, dtype)
+        carry0 = (x0, y0, z0l, z0u, best0, jnp.array(0), jnp.array(False), tr0)
+    else:
+        carry0 = (
+            state0.x,
+            state0.y,
+            state0.zl,
+            state0.zu,
+            (
+                state0.best_merit,
+                state0.best_x,
+                state0.best_y,
+                state0.best_zl,
+                state0.best_zu,
+                state0.best_it,
+            ),
+            state0.it,
+            state0.done,
+            state0.trace,
+        )
+    state = lax.while_loop(cond, body, carry0)
+    xf, yf, zlf, zuf, best, it, done, tr_out = state
     _, x, y, zl, zu, _ = best
     rp, rd, comp = residuals(x, y, zl, zu)
     # report convergence from actual final residuals (the loop's `done` flag
@@ -493,22 +661,27 @@ def _solve_scaled(
     rd_rel = jnp.linalg.norm(rd) / cnorm
     gap_rel = comp / (1.0 + jnp.abs(c @ x))
     conv = (rp_rel < 100 * tol) & (rd_rel < 100 * tol) & (gap_rel < 100 * tol)
-    return (
-        IPMSolution(
-            x=x,
-            y=y,
-            zl=zl,
-            zu=zu,
-            obj=c @ x + c0,
-            converged=conv,
-            iterations=it,
-            res_primal=rp_rel,
-            res_dual=rd_rel,
-            gap=gap_rel,
-            status=_classify_exit(conv, rp_rel, rd_rel),
-        ),
-        tr_out,
+    sol = IPMSolution(
+        x=x,
+        y=y,
+        zl=zl,
+        zu=zu,
+        obj=c @ x + c0,
+        converged=conv,
+        iterations=it,
+        res_primal=rp_rel,
+        res_dual=rd_rel,
+        gap=gap_rel,
+        status=_classify_exit(conv, rp_rel, rd_rel),
     )
+    if return_state:
+        bm, bx, by, bzl, bzu, bit = best
+        return sol, tr_out, IPMState(
+            x=xf, y=yf, zl=zlf, zu=zuf,
+            best_merit=bm, best_x=bx, best_y=by, best_zl=bzl, best_zu=bzu,
+            best_it=bit, it=it, done=done, trace=tr_out,
+        )
+    return sol, tr_out
 
 
 def _classify_exit(conv, rp_rel, rd_rel):
@@ -532,12 +705,17 @@ def _classify_exit(conv, rp_rel, rd_rel):
     )
 
 
-def solve_lp_batch(lp: LPData, **kw) -> IPMSolution:
+def solve_lp_batch(lp: LPData, warm_start=None, **kw) -> IPMSolution:
     """vmap convenience over a leading batch axis present on any LP field.
 
     Fields without the batch axis are broadcast (e.g. shared A with
     per-scenario b/c — the common price-taker case where only LMPs differ,
     reference `wind_battery_LMP.py:243-244`).
+
+    `warm_start`, when given, is a per-lane ``(x, y, zl, zu)`` tuple of
+    batched arrays (leading axis = batch) mapped alongside the LP data;
+    each lane applies the safeguarded warm-start logic of `solve_lp`
+    independently.
     """
     batch = None
     axes = []
@@ -551,6 +729,12 @@ def solve_lp_batch(lp: LPData, **kw) -> IPMSolution:
         else:
             raise ValueError(f"bad ndim for {name}")
     if batch is None:
-        return solve_lp(lp, **kw)
-    fn = jax.vmap(lambda d: solve_lp(d, **kw), in_axes=(LPData(*axes),))
-    return fn(lp)
+        return solve_lp(lp, warm_start=warm_start, **kw)
+    if warm_start is None:
+        fn = jax.vmap(lambda d: solve_lp(d, **kw), in_axes=(LPData(*axes),))
+        return fn(lp)
+    fn = jax.vmap(
+        lambda d, w: solve_lp(d, warm_start=w, **kw),
+        in_axes=(LPData(*axes), 0),
+    )
+    return fn(lp, tuple(warm_start))

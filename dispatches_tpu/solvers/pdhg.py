@@ -24,7 +24,7 @@ from jax import lax
 
 from ..core.program import SparseLP
 from ..obs.retrace import note_trace, signature_of
-from ..obs.trace import empty_trace as _empty_trace, record as _tr_record
+from ..obs.trace import SolveTrace, empty_trace as _empty_trace, record as _tr_record
 
 
 class PDHGSolution(NamedTuple):
@@ -35,6 +35,22 @@ class PDHGSolution(NamedTuple):
     iterations: jnp.ndarray
     res_primal: jnp.ndarray
     res_dual: jnp.ndarray
+
+
+class PDHGState(NamedTuple):
+    """Opaque resumable outer-loop state for segmented PDHG solves (the
+    analogue of `ipm.IPMState`): the current iterate in the solver's
+    internal scaled frame plus the loop counters and the running trace.
+    Feed it back to `solve_lp_pdhg` with the SAME `lp` to resume the exact
+    iterate sequence — the chunked solve is bitwise identical to the
+    one-shot solve. Only `it` / `done` are meant for host-side retirement
+    decisions (`runtime/adaptive.py`)."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    it: jnp.ndarray
+    done: jnp.ndarray
+    trace: "SolveTrace"
 
 
 def _matvec(rows, cols, vals, M, x):
@@ -62,19 +78,37 @@ def _ruiz_sparse(rows, cols, vals, M, N, iters=10):
     return lax.fori_loop(0, iters, body, (r, c))
 
 
-@partial(jax.jit, static_argnames=("max_iter", "check_every", "trace"))
+@partial(
+    jax.jit,
+    static_argnames=("max_iter", "check_every", "trace", "return_state"),
+)
 def solve_lp_pdhg(
     lp: SparseLP,
     tol: float = 1e-6,
     max_iter: int = 100_000,
     check_every: int = 200,
     trace: bool = False,
+    warm_start=None,
+    state: PDHGState = None,
+    it_stop=None,
+    return_state: bool = False,
 ) -> PDHGSolution:
     """`trace=True` returns ``(PDHGSolution, SolveTrace)``: one trace entry
     per *convergence check* (every `check_every` iterations, so traces have
     ``ceil(max_iter / check_every)`` slots) with the relative KKT residuals,
     a duality-gap estimate, and the constant primal/dual step sizes.
-    Tracing off is bitwise identical to the untraced solver."""
+    Tracing off is bitwise identical to the untraced solver.
+
+    `warm_start` = (x, y) in the solution frame seeds the iteration
+    (primal projected into the box — PDHG converges from any start, so no
+    rejection logic is needed). `state`/`it_stop`/`return_state` expose
+    the segmented-solve primitive for `runtime/adaptive.py`: run the
+    outer loop until the iteration counter reaches ``it_stop`` (traced;
+    make it a multiple of ``check_every`` — the outer loop only tests
+    between check periods), return the resumable `PDHGState` appended to
+    the normal return value, and feed it back with the same `lp` to
+    continue the exact iterate sequence. All default to off, leaving the
+    historical solve untouched bitwise."""
     note_trace("solve_lp_pdhg", signature_of(*lp))
     rows, cols, vals0, b0, c0v, l0, u0, off = lp
     M, N = b0.shape[0], c0v.shape[0]
@@ -125,6 +159,16 @@ def solve_lp_pdhg(
 
     x0 = proj(jnp.zeros((N,), dtype))
     y0 = jnp.zeros((M,), dtype)
+    if warm_start is not None:
+        # solution frame -> scaled frame (inverse of the unscale below);
+        # projection makes any primal seed box-feasible, and nonfinite
+        # seeds fall back to the cold start wholesale
+        xw, yw = warm_start
+        xw = jnp.asarray(xw, dtype) / (cs * sig_b)
+        yw = jnp.asarray(yw, dtype) / (r * sig_c)
+        ok_w = jnp.all(jnp.isfinite(xw)) & jnp.all(jnp.isfinite(yw))
+        x0 = jnp.where(ok_w, proj(xw), x0)
+        y0 = jnp.where(ok_w, yw, y0)
 
     def inner(carry, _):
         x, y, xs, ys, cnt = carry
@@ -134,9 +178,17 @@ def solve_lp_pdhg(
         yn = y + sig * (b - axe)
         return (xn, yn, xs + xn, ys + yn, cnt + 1.0), None
 
-    def outer_cond(state):
-        x, y, it, done, tr = state
-        return (it < max_iter) & (~done)
+    if it_stop is None:
+        def outer_cond(st):
+            x, y, it, done, tr = st
+            return (it < max_iter) & (~done)
+    else:
+        # traced stop mark: every segment boundary reuses one executable
+        it_cap = jnp.minimum(jnp.asarray(it_stop), max_iter)
+
+        def outer_cond(st):
+            x, y, it, done, tr = st
+            return (it < it_cap) & (~done)
 
     def outer_body(state):
         x, y, it, _, tr = state
@@ -169,10 +221,12 @@ def solve_lp_pdhg(
         return (x_new, y_new, it + check_every, done, tr)
 
     n_checks = -(-max_iter // check_every)  # ceil
-    tr0 = _empty_trace(n_checks if trace else 0, dtype)
-    x, y, it, done, tr_out = lax.while_loop(
-        outer_cond, outer_body, (x0, y0, jnp.array(0), jnp.array(False), tr0)
-    )
+    if state is None:
+        tr0 = _empty_trace(n_checks if trace else 0, dtype)
+        carry0 = (x0, y0, jnp.array(0), jnp.array(False), tr0)
+    else:
+        carry0 = (state.x, state.y, state.it, state.done, state.trace)
+    x, y, it, done, tr_out = lax.while_loop(outer_cond, outer_body, carry0)
 
     # unscale
     x_out = x * cs * sig_b
@@ -187,4 +241,7 @@ def solve_lp_pdhg(
         res_primal=rp,
         res_dual=rd,
     )
+    if return_state:
+        st_out = PDHGState(x=x, y=y, it=it, done=done, trace=tr_out)
+        return (sol, tr_out, st_out) if trace else (sol, st_out)
     return (sol, tr_out) if trace else sol

@@ -859,13 +859,14 @@ def _ruiz_banded(Ad, As, Bb, iters: int = 8):
     static_argnames=(
         "meta", "max_iter", "refine_steps", "d_cap", "slabs", "mesh",
         "chol_dtype", "kkt_refine", "inv_factors", "sweep_backend",
-        "correctors", "trace",
+        "correctors", "trace", "return_state",
     ),
 )
 def _solve_banded_jit(
     meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs=None,
     mesh=None, chol_dtype=None, kkt_refine=0, fac_d_cap=None,
     inv_factors=False, sweep_backend="xla", correctors=0, trace=False,
+    warm_start=None, state=None, it_stop=None, return_state=False,
 ):
     note_trace("solve_lp_banded", signature_of(*blp))
     Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0 = blp
@@ -901,7 +902,29 @@ def _solve_banded_jit(
             fac_d_cap=fac_d_cap, inv_factors=inv_factors,
             sweep_backend=sweep_backend,
         )
-        sol, tr = _solve_scaled(
+        warm_s = None
+        if warm_start is not None:
+            # Solution-frame warm iterate (reduced column order / banded
+            # row order, e.g. a neighbor's IPMSolution fields) -> the
+            # solver's flat scaled frame: scatter through col_pos (the
+            # exact inverse of the unscale/gather below; padding slots
+            # get 0, which the interior safeguard in _solve_scaled clips
+            # inside their inert [0, 1] box at negligible shift).
+            xw, yw, zlw, zuw = warm_start
+            col_pos = jnp.asarray(meta.col_pos)
+
+            def _scatter(v):
+                return jnp.zeros(nt + p, dtype).at[col_pos].set(
+                    v.astype(dtype)
+                )
+
+            warm_s = (
+                _scatter(xw) / (cs_all * sig_b),
+                (yw.astype(dtype).reshape(Tb, mB) / (r * sig_c)).reshape(-1),
+                _scatter(zlw) * cs_all / sig_c,
+                _scatter(zuw) * cs_all / sig_c,
+            )
+        out_scaled = _solve_scaled(
             LPData(
                 A=None,
                 b=b_s / sig_b,
@@ -920,7 +943,12 @@ def _solve_banded_jit(
             d_cap=d_cap,
             correctors=correctors,
             trace=trace,
+            warm=warm_s,
+            state0=state,
+            it_stop=it_stop,
+            return_state=return_state,
         )
+        sol, tr = out_scaled[:2]
         # unscale and map back to the CompiledLP's reduced column order
         x_flat = sol.x * cs_all * sig_b
         x_red = x_flat[jnp.asarray(meta.col_pos)]
@@ -945,6 +973,8 @@ def _solve_banded_jit(
         gap=sol.gap,
         status=sol.status,
     )
+    if return_state:
+        return (out, tr, out_scaled[2]) if trace else (out, out_scaled[2])
     return (out, tr) if trace else out
 
 
@@ -993,6 +1023,10 @@ def solve_lp_banded(
     sweep_backend: str = "xla",
     correctors: int = 0,
     trace: bool = False,
+    warm_start=None,
+    state=None,
+    it_stop=None,
+    return_state: bool = False,
 ) -> IPMSolution:
     """Solve a time-banded LP by the block-tridiagonal IPM. Returns a
     solution with ``x`` in the CompiledLP's reduced column order, so
@@ -1049,7 +1083,17 @@ def solve_lp_banded(
     ``trace=True`` additionally returns the per-iteration `SolveTrace`
     (relative residuals, gap, step sizes, NaN-padded to ``max_iter``); the
     return value becomes ``(IPMSolution, SolveTrace)``. Tracing off is
-    bitwise identical to the untraced solver."""
+    bitwise identical to the untraced solver.
+
+    ``warm_start`` = (x, y, zl, zu) in the solution frame (reduced column
+    order / banded row order — a neighbor's `IPMSolution` fields) seeds
+    the iteration with the same safeguarded fallback as `solve_lp`.
+    ``state``/``it_stop``/``return_state`` expose the segmented-solve
+    primitive (see `solve_lp_partial`): run to iteration ``it_stop``
+    (traced), return the resumable `IPMState` appended to the normal
+    return value, feed it back with the same data to continue bitwise
+    exactly. These serve `runtime/adaptive.py`; all default to off and
+    leave the historical solve untouched."""
     _warn_small_T_f32(meta, blp)
     dtype = blp.Ad.dtype
     if chol_dtype is not None:
@@ -1120,7 +1164,7 @@ def solve_lp_banded(
     return _solve_banded_jit(
         meta, blp, tol, max_iter, reg_p, reg_d, refine_steps, d_cap, slabs,
         mesh, chol_dtype, kkt_refine, fac_d_cap, inv_factors, sweep_backend,
-        correctors, trace,
+        correctors, trace, warm_start, state, it_stop, return_state,
     )
 
 
@@ -1128,6 +1172,7 @@ def solve_lp_banded_batch(
     meta: TimeStructure,
     blp: BandedLP,
     sharding=None,
+    warm_start=None,
     **kw,
 ) -> IPMSolution:
     """vmap convenience over a leading scenario axis on any BandedLP field —
@@ -1175,7 +1220,7 @@ def solve_lp_banded_batch(
                 f"or {nd + 1})"
             )
     if batch is None:
-        return solve_lp_banded(meta, blp, **kw)
+        return solve_lp_banded(meta, blp, warm_start=warm_start, **kw)
     if sharding is not None:
         # placing the inputs (device_put, not with_sharding_constraint —
         # this runs outside jit) pins the batch axis one-shard-per-device;
@@ -1184,8 +1229,17 @@ def solve_lp_banded_batch(
             jax.device_put(arr, sharding) if ax == 0 else arr
             for arr, ax in zip(blp, axes)
         ))
-    fn = jax.vmap(lambda d: solve_lp_banded(meta, d, **kw), in_axes=(BandedLP(*axes),))
-    return fn(blp)
+    if warm_start is None:
+        fn = jax.vmap(
+            lambda d: solve_lp_banded(meta, d, **kw), in_axes=(BandedLP(*axes),)
+        )
+        return fn(blp)
+    # per-lane (x, y, zl, zu) warm seeds, batched along the leading axis
+    fn = jax.vmap(
+        lambda d, w: solve_lp_banded(meta, d, warm_start=w, **kw),
+        in_axes=(BandedLP(*axes), 0),
+    )
+    return fn(blp, tuple(warm_start))
 
 
 def optimal_value_banded(

@@ -222,6 +222,8 @@ def run_year_sweep(
     tracer=None,
     trace: bool = False,
     cost: bool = False,
+    warm_starts: bool = False,
+    adaptive: bool = False,
 ):
     """Year-scale LMP-scenario design sweep — the BASELINE.md north-star
     workload as a user entry point: N full-year (8,760 h) wind+battery+PEM
@@ -250,7 +252,20 @@ def run_year_sweep(
     per-batch roofline-utilization estimate to those solve events. The
     cost probe compiles the batched solver a second time (outside the jit
     call cache), so it runs once, on the first batch only — every later
-    batch reuses the static record with its own measured wall-clock."""
+    batch reuses the static record with its own measured wall-clock.
+
+    `warm_starts=True` (CLI `--warm-starts`) seeds each scenario from its
+    nearest solved neighbor (by LMP scale) in the PREVIOUS batch: pending
+    scenarios are sorted by scale so chunk n+1's lanes sit next to chunk
+    n's, and the solver's safeguarded warm entry falls back to a cold
+    start per lane whenever the neighbor iterate is infeasible-shifted
+    (docs/performance.md). Iterations saved against the cold first-batch
+    baseline land in `warm_start_iters_saved_total`. `adaptive=True`
+    (CLI `--adaptive`) routes batches through
+    `runtime.adaptive.solve_lp_banded_adaptive` — converged lanes retire
+    early and the batch compacts to the bucket ladder; per-batch driver
+    stats ride on the journal solve events. Both default OFF, leaving
+    the historical solve path untouched bitwise."""
     import time as _time
 
     import jax
@@ -340,12 +355,17 @@ def run_year_sweep(
         k for k in range(scenarios)
         if not any(key in done for key in _keys(k))
     ]
+    if warm_starts:
+        # neighbor seeding wants adjacent scales in adjacent chunks
+        pending.sort(key=lambda k: scales[k])
     if len(pending) < scenarios:
         obs_metrics.inc("year_scenarios_skipped_total",
                         scenarios - len(pending), runner="yearsweep")
         if verbose:
             print(f"{scenarios - len(pending)} scenarios checkpointed, skipping")
     cost_rec = None  # filled on the first batch when cost=True
+    prev_sols = None  # (scales, x, y, zl, zu) of the previous chunk
+    cold_iter_mean = None  # first (cold) batch's mean iterations
     with tracer.span(
         "year_sweep", scenarios=scenarios, batch=batch, hours=hours,
         dtype=str(jdtype),
@@ -374,10 +394,30 @@ def run_year_sweep(
                         )
                     except Exception as e:  # accounting must not kill the sweep
                         cost_rec = {"error": f"{type(e).__name__}: {e}"}
+                warm_b = None
+                if warm_starts and prev_sols is not None:
+                    # nearest solved neighbor (by LMP scale) seeds each lane
+                    ps, px, py, pzl, pzu = prev_sols
+                    nn = np.asarray([
+                        int(np.argmin(np.abs(ps - scales[k]))) for k in padded
+                    ])
+                    warm_b = tuple(
+                        jnp.asarray(a[nn]) for a in (px, py, pzl, pzu)
+                    )
+                ad_stats = {} if adaptive else None
                 t0 = _time.perf_counter()
-                solve_out = solve_lp_banded_batch(
-                    meta, blp_b, trace=trace, **solver_kw
-                )
+                if adaptive:
+                    from ..runtime.adaptive import solve_lp_banded_adaptive
+
+                    solve_out = solve_lp_banded_adaptive(
+                        meta, blp_b, warm_start=warm_b, trace=trace,
+                        stats=ad_stats, **solver_kw
+                    )
+                else:
+                    solve_out = solve_lp_banded_batch(
+                        meta, blp_b, warm_start=warm_b, trace=trace,
+                        **solver_kw
+                    )
                 sol, sol_tr = solve_out if trace else (solve_out, None)
                 convs = np.asarray(sol.converged)[: len(todo)]
                 solve_wall = _time.perf_counter() - t0
@@ -389,6 +429,7 @@ def run_year_sweep(
                     )(sol.x, lmps)
                 )[: len(todo)]
                 stats = batch_stats(sol)
+                iters_b = np.asarray(sol.iterations)[: len(todo)]
                 batch_cost = None
                 if cost_rec is not None:
                     from ..obs import cost as obs_cost
@@ -400,8 +441,30 @@ def run_year_sweep(
                     obs_metrics.inc("year_scenarios_unconverged_total",
                                     len(todo) - int(convs.sum()),
                                     runner="yearsweep")
+                obs_metrics.inc("ipm_iterations_total",
+                                float(iters_b.sum()), runner="yearsweep")
+                if warm_b is None:
+                    if cold_iter_mean is None:
+                        cold_iter_mean = float(iters_b.mean())
+                else:
+                    # iterations saved vs the cold first-batch baseline —
+                    # an estimate (the cold path isn't re-solved), but a
+                    # consistent one across chunks of the same sweep
+                    saved = cold_iter_mean * len(todo) - float(iters_b.sum())
+                    if saved > 0:
+                        obs_metrics.inc("warm_start_iters_saved_total",
+                                        saved, runner="yearsweep")
+                if warm_starts:
+                    prev_sols = (
+                        np.asarray(scales)[padded],
+                        np.asarray(sol.x), np.asarray(sol.y),
+                        np.asarray(sol.zl), np.asarray(sol.zu),
+                    )
                 tracer.solve_event(
-                    "year_batch", sol, trace=sol_tr, cost=batch_cost
+                    "year_batch", sol, trace=sol_tr, cost=batch_cost,
+                    warm_starts=bool(warm_b is not None), adaptive=adaptive,
+                    iterations_total=int(iters_b.sum()),
+                    **({"adaptive_stats": ad_stats} if ad_stats else {}),
                 )
                 # flight recorder (opt-in via --record-failures): snapshot
                 # the batched problem instance when any lane went bad, so
@@ -434,6 +497,7 @@ def run_year_sweep(
                     "lmp_scale": float(scales[k]),
                     "NPV": float(npvs[j]),
                     "converged": bool(convs[j]),
+                    "iterations": int(iters_b[j]),
                     "solver_stats": stats,
                 }
                 out.append(rec)
@@ -545,6 +609,12 @@ def main(argv=None):
         "TraceAnnotations",
     )
     p.add_argument(
+        "--cache-dir", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory (defaults to the "
+        "DISPATCHES_TPU_CACHE_DIR environment variable; compiled "
+        "executables survive process restarts — docs/performance.md)",
+    )
+    p.add_argument(
         "--record-failures", default=None, metavar="DIR",
         help="flight recorder: snapshot every failed/non-healthy solve "
         "(problem arrays + options + manifest) into a capped ring buffer "
@@ -588,6 +658,16 @@ def main(argv=None):
                     help="store block factors as inverses (TPU sweep speed)")
     ys.add_argument("--out", default=None, help="ResultStore checkpoint path")
     ys.add_argument(
+        "--warm-starts", action="store_true",
+        help="seed each scenario from its nearest solved neighbor in the "
+        "previous batch (safeguarded; falls back to cold per lane)",
+    )
+    ys.add_argument(
+        "--adaptive", action="store_true",
+        help="adaptive batching: retire converged lanes between iteration "
+        "chunks and compact the batch (runtime.adaptive)",
+    )
+    ys.add_argument(
         "--cost", action="store_true",
         help="attach XLA cost-model FLOPs/bytes/memory + roofline records "
         "to journal solve events (compiles the solver once more; obs.cost)",
@@ -599,6 +679,11 @@ def main(argv=None):
     )
 
     args = p.parse_args(argv)
+    from ..runtime.adaptive import enable_persistent_cache
+
+    # no-op unless --cache-dir or DISPATCHES_TPU_CACHE_DIR is set; safe
+    # before platform handling (config only, no backend initialization)
+    enable_persistent_cache(args.cache_dir)
     if getattr(args, "platform", "default") == "cpu":
         from ..parallel.mesh import force_virtual_cpu_mesh
 
@@ -662,6 +747,8 @@ def main(argv=None):
                     inv_factors=args.inv_factors,
                     store_path=args.out,
                     cost=args.cost,
+                    warm_starts=args.warm_starts,
+                    adaptive=args.adaptive,
                 )
     finally:
         if recorder is not None:

@@ -19,6 +19,12 @@ if not force_virtual_cpu_mesh(8):
     )
 jax.config.update("jax_enable_x64", True)
 
+# persistent XLA compile cache: no-op unless DISPATCHES_TPU_CACHE_DIR is
+# set (CI sets it, paired with actions/cache — .github/workflows/checks.yml)
+from dispatches_tpu.runtime.adaptive import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -1,0 +1,306 @@
+"""Adaptive batched solving (runtime/adaptive.py) identity tests.
+
+(The `zz_` prefix is deliberate: every test here compiles several
+distinct-batch-shape solver executables, which costs minutes on a
+single-core CPU runner — running them last keeps the fast physics and
+solver suites at the front of a time-boxed tier-1 window.)
+
+The engine's contract: lane retirement + chunked resume reproduce the
+monolithic one-shot vmapped solve — bitwise, traces included — at an
+unchanged bucket size, for all three solver entry points (dense IPM,
+banded IPM, PDHG). After a COMPACTION that shrinks the batch, iteration
+counts and convergence flags stay exactly equal; solution values are
+asserted to tight tolerance rather than bitwise because CPU lowers
+vmapped dense Cholesky to batched LAPACK kernels whose last-bit rounding
+depends on the batch count (see the module docstring of
+`runtime/adaptive.py`). The banded path factors per-block inside a
+`lax.scan`, which IS batch-size-invariant, so its compaction asserts
+stay bitwise.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dispatches_tpu.case_studies.renewables import params as P
+from dispatches_tpu.case_studies.renewables.pricetaker import (
+    HybridDesign,
+    build_pricetaker,
+)
+from dispatches_tpu.core.program import LPData, SparseLP
+from dispatches_tpu.runtime.adaptive import (
+    bucket_ladder,
+    next_bucket,
+    solve_lp_adaptive,
+    solve_lp_banded_adaptive,
+    solve_lp_pdhg_adaptive,
+    warmup_ladder,
+)
+from dispatches_tpu.solvers.ipm import solve_lp, solve_lp_batch
+
+DATA = P.load_rts303()
+T = 24
+
+
+def _prog():
+    design = HybridDesign(
+        T=T,
+        with_battery=True,
+        with_pem=True,
+        design_opt=True,
+        h2_price_per_kg=2.5,
+        initial_soc_fixed=None,
+    )
+    prog, _ = build_pricetaker(design)
+    return prog
+
+
+def _dense_batch(prog, scales):
+    lmp = jnp.asarray(DATA["da_lmp"][:T], jnp.float64)
+    cf = jnp.asarray(DATA["da_wind_cf"][:T], jnp.float64)
+    lps = [
+        prog.instantiate({"lmp": lmp * s, "wind_cf": cf}) for s in scales
+    ]
+    return LPData(*(jnp.stack([lp[i] for lp in lps]) for i in range(len(lps[0]))))
+
+
+def _biteq(a, b):
+    """Bitwise equality with NaN==NaN (trace fill slots are NaN)."""
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and bool(
+        np.all((a == b) | (np.isnan(a) & np.isnan(b)))
+    )
+
+
+def _assert_bitwise(ref, out):
+    for name, a, b in zip(ref._fields, ref, out):
+        assert _biteq(a, b), f"field {name} differs bitwise"
+
+
+SCALES = np.linspace(0.7, 1.3, 6)
+KW = dict(max_iter=60)
+
+
+def test_ladder_helpers():
+    assert bucket_ladder(16, base=4) == [4, 8, 16]
+    assert bucket_ladder(16, base=16) == [16]
+    assert bucket_ladder(5, base=2) == [2, 4, 5]
+    ladder = bucket_ladder(16, base=4)
+    assert next_bucket(3, ladder) == 4
+    assert next_bucket(4, ladder) == 4
+    assert next_bucket(9, ladder) == 16
+    with pytest.raises(ValueError):
+        bucket_ladder(0)
+
+
+def test_dense_chunked_resume_bitwise():
+    """Chunked solve at an unchanged bucket == one-shot, traces included."""
+    prog = _prog()
+    lp = _dense_batch(prog, SCALES)
+    ref, tr_ref = solve_lp_batch(lp, trace=True, **KW)
+    stats = {}
+    out, tr = solve_lp_adaptive(
+        lp, chunk_iters=3, ladder_base=len(SCALES), trace=True, stats=stats,
+        **KW,
+    )
+    _assert_bitwise(ref, out)
+    _assert_bitwise(tr_ref, tr)
+    # lanes converge at different counts, so retirement must have happened
+    its = np.asarray(ref.iterations)
+    if its.min() != its.max():
+        assert stats["lanes_retired"] > 0
+    assert stats["buckets"] == [len(SCALES)] * stats["chunks"]
+
+
+def test_dense_compaction_exact_iterates():
+    """Compacted resume: identical iteration counts/flags, tight allclose
+    on values (bitwise is platform-dependent after a dense-batch shrink —
+    see runtime/adaptive.py)."""
+    prog = _prog()
+    lp = _dense_batch(prog, SCALES)
+    ref = solve_lp_batch(lp, **KW)
+    # warm-mixed batch guarantees an iteration spread: exact-solution
+    # seeds converge in ~2 iterations, NaN seeds reject to cold starts
+    seeds = [np.asarray(a).copy() for a in (ref.x, ref.y, ref.zl, ref.zu)]
+    for a in seeds:
+        a[-2:] = np.nan
+    seeds = tuple(jnp.asarray(a) for a in seeds)
+    ref_w = solve_lp_batch(lp, warm_start=seeds, **KW)
+    stats = {}
+    out = solve_lp_adaptive(
+        lp, chunk_iters=2, ladder_base=2, warm_start=seeds, stats=stats,
+        **KW,
+    )
+    assert np.array_equal(np.asarray(ref_w.iterations), np.asarray(out.iterations))
+    assert np.array_equal(np.asarray(ref_w.converged), np.asarray(out.converged))
+    assert np.array_equal(np.asarray(ref_w.status), np.asarray(out.status))
+    for name, a, b in zip(ref_w._fields, ref_w, out):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float64), np.asarray(b, dtype=np.float64),
+            rtol=1e-9, atol=1e-9, err_msg=f"field {name}",
+        )
+    assert stats["lanes_retired"] > 0
+    assert min(stats["buckets"]) < len(SCALES), "compaction never happened"
+
+
+def test_dense_warm_reject_falls_back_cold_bitwise():
+    """A garbage warm start is rejected wholesale: the solve is bitwise
+    the cold solve, not a degraded warm one."""
+    prog = _prog()
+    lp = _dense_batch(prog, SCALES[:1])
+    one = LPData(*(a[0] for a in lp))
+    cold = solve_lp(one, **KW)
+    n, m = one.c.shape[0], one.b.shape[0]
+    garbage = (
+        jnp.full((n,), jnp.nan), jnp.zeros((m,)),
+        jnp.ones((n,)), jnp.ones((n,)),
+    )
+    warm = solve_lp(one, warm_start=garbage, **KW)
+    _assert_bitwise(cold, warm)
+    # a shifted-but-finite seed far outside the box also rejects
+    shifted = (
+        jnp.full((n,), 1e9), jnp.zeros((m,)),
+        jnp.ones((n,)), jnp.ones((n,)),
+    )
+    warm2 = solve_lp(one, warm_start=shifted, **KW)
+    _assert_bitwise(cold, warm2)
+
+
+def test_dense_warm_start_saves_iterations():
+    """A neighbor-solution seed converges in fewer iterations than cold."""
+    prog = _prog()
+    lp = _dense_batch(prog, SCALES[:1])
+    one = LPData(*(a[0] for a in lp))
+    cold = solve_lp(one, **KW)
+    warm = solve_lp(
+        one, warm_start=(cold.x, cold.y, cold.zl, cold.zu), **KW
+    )
+    assert bool(np.asarray(warm.converged))
+    assert int(np.asarray(warm.iterations)) < int(np.asarray(cold.iterations))
+
+
+@pytest.mark.slow
+def test_warmup_ladder_compiles_all_rungs():
+    prog = _prog()
+    lp = _dense_batch(prog, SCALES)
+    ladder = warmup_ladder(lp, chunk_iters=3, ladder_base=2, **KW)
+    assert ladder == bucket_ladder(len(SCALES), 2)
+    # warmed executables must produce the same bitwise result
+    ref = solve_lp_batch(lp, **KW)
+    out = solve_lp_adaptive(lp, chunk_iters=3, ladder_base=2, **KW)
+    assert np.array_equal(np.asarray(ref.iterations), np.asarray(out.iterations))
+
+
+@pytest.mark.slow
+def test_banded_adaptive_bitwise_including_compaction():
+    """The banded path factors per block inside lax.scan (batch-size
+    invariant), so even the compacted resume is asserted bitwise."""
+    from dispatches_tpu.solvers.structured import (
+        BandedLP,
+        extract_time_structure,
+        solve_lp_banded_batch,
+    )
+
+    Tb = 48
+    design = HybridDesign(
+        T=Tb,
+        with_battery=True,
+        with_pem=True,
+        design_opt=True,
+        h2_price_per_kg=2.5,
+        initial_soc_fixed=None,
+    )
+    prog, _ = build_pricetaker(design)
+    meta = extract_time_structure(prog, Tb, block_hours=12)
+    lmp = jnp.asarray(DATA["da_lmp"][:Tb], jnp.float64)
+    cf = jnp.asarray(DATA["da_wind_cf"][:Tb], jnp.float64)
+    rows = [
+        meta.instantiate({"lmp": lmp * s, "wind_cf": cf})
+        for s in (0.7, 0.9, 1.1, 1.3)
+    ]
+    blp = BandedLP(*(
+        jnp.stack([jnp.asarray(r[i]) for r in rows])
+        for i in range(len(rows[0]))
+    ))
+    ref, tr_ref = solve_lp_banded_batch(meta, blp, trace=True, **KW)
+    stats = {}
+    out, tr = solve_lp_banded_adaptive(
+        meta, blp, chunk_iters=4, ladder_base=2, trace=True, stats=stats,
+        **KW,
+    )
+    _assert_bitwise(ref, out)
+    _assert_bitwise(tr_ref, tr)
+    assert stats["adaptive_entry"] == "solve_lp_banded"
+
+
+def test_pdhg_adaptive_bitwise():
+    from dispatches_tpu.solvers.pdhg import solve_lp_pdhg
+
+    prog = _prog()
+    lp = _dense_batch(prog, SCALES[:3])
+    A = np.asarray(lp.A[0])
+    r_, c_ = np.nonzero(A)
+    rows = jnp.asarray(r_, jnp.int32)
+    cols = jnp.asarray(c_, jnp.int32)
+    vals = jnp.asarray(A[r_, c_])
+    lps = SparseLP(
+        rows=rows, cols=cols, vals=vals, b=lp.b[0], c=lp.c,
+        l=lp.l[0], u=lp.u[0], c0=lp.c0,
+    )
+    kw = dict(tol=5e-3, max_iter=4000, check_every=100, trace=True)
+    ref, tr_ref = jax.vmap(
+        lambda c, c0: solve_lp_pdhg(
+            SparseLP(rows, cols, vals, lps.b, c, lps.l, lps.u, c0), **kw
+        ),
+        in_axes=(0, 0),
+    )(lps.c, lps.c0)
+    stats = {}
+    out, tr = solve_lp_pdhg_adaptive(
+        lps, chunk_iters=400, ladder_base=2, stats=stats, **kw
+    )
+    _assert_bitwise(ref, out)
+    _assert_bitwise(tr_ref, tr)
+
+    # a batched sparsity pattern is rejected, not silently mis-solved
+    bad = lps._replace(rows=jnp.stack([rows] * 3), cols=jnp.stack([cols] * 3))
+    with pytest.raises(ValueError, match="shared sparsity"):
+        solve_lp_pdhg_adaptive(bad, **dict(kw, trace=False))
+
+
+def test_adaptive_unbatched_falls_back():
+    prog = _prog()
+    lp = _dense_batch(prog, SCALES[:1])
+    one = LPData(*(a[0] for a in lp))
+    ref = solve_lp(one, **KW)
+    out = solve_lp_adaptive(one, **KW)
+    _assert_bitwise(ref, out)
+
+
+def test_sharded_solve_auto_pads_uneven_batch():
+    """solve_lp_sharded pads a batch that doesn't divide the device count
+    (mesh.py used to raise) and slices the padding back off."""
+    from dispatches_tpu.parallel.mesh import scenario_mesh, solve_lp_sharded
+
+    prog = _prog()
+    lp = _dense_batch(prog, SCALES)  # 6 lanes over the 8-device test mesh
+    mesh = scenario_mesh()
+    assert lp.b.shape[0] % mesh.devices.size != 0
+    out = solve_lp_sharded(lp, mesh, **KW)
+    ref = solve_lp_batch(lp, **KW)
+    assert out.x.shape[0] == lp.b.shape[0]
+    assert np.array_equal(np.asarray(ref.converged), np.asarray(out.converged))
+    np.testing.assert_allclose(
+        np.asarray(ref.obj), np.asarray(out.obj), rtol=1e-8, atol=1e-8
+    )
+
+
+def test_enable_persistent_cache_noop_without_env(tmp_path, monkeypatch):
+    from dispatches_tpu.runtime.adaptive import enable_persistent_cache
+
+    monkeypatch.delenv("DISPATCHES_TPU_CACHE_DIR", raising=False)
+    assert enable_persistent_cache() is None
+    target = tmp_path / "xla-cache"
+    got = enable_persistent_cache(str(target))
+    assert got == str(target)
+    assert target.is_dir()
+    assert jax.config.jax_compilation_cache_dir == str(target)

@@ -55,7 +55,8 @@ DEFAULT_THRESHOLD = 0.10
 # gate with change=+inf
 _HIGHER_IS_BETTER = (
     "per_sec", "per_chip", "converged", "mfu", "tflops", "utilization",
-    "throughput", 'verdict="healthy"',
+    "throughput", 'verdict="healthy"', "iters_saved", "cache_hit",
+    "lanes_retired",
 )
 
 
@@ -387,6 +388,39 @@ def self_check(out=sys.stdout) -> int:
           {**clean,
            'metric/solve_verdict_total{solve="solve_nlp",verdict="healthy"}':
            4.0}, False)
+
+    # adaptive-batching counters (runtime/adaptive.py): total IPM
+    # iterations are lower-is-better (the warm-start/retirement win the
+    # gate protects), iterations-saved and cache hits higher-is-better
+    abase = {
+        'metric/ipm_iterations_total{runner="yearsweep"}': 400.0,
+        'metric/warm_start_iters_saved_total{runner="yearsweep"}': 80.0,
+        'metric/compile_cache_hit_total{entry="solve_lp_banded"}': 12.0,
+    }
+
+    def arun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(abase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    arun("identical adaptive counters pass", dict(abase), False)
+    arun("15% more IPM iterations fail (lower is better)",
+         {**abase,
+          'metric/ipm_iterations_total{runner="yearsweep"}': 460.0}, True)
+    arun("IPM iterations dropping passes",
+         {**abase,
+          'metric/ipm_iterations_total{runner="yearsweep"}': 320.0}, False)
+    arun("warm-start savings dropping >10% fails (higher is better)",
+         {**abase,
+          'metric/warm_start_iters_saved_total{runner="yearsweep"}': 40.0},
+         True)
+    arun("warm-start savings growing passes",
+         {**abase,
+          'metric/warm_start_iters_saved_total{runner="yearsweep"}': 120.0},
+         False)
+    arun("compile-cache hits dropping >10% fails",
+         {**abase,
+          'metric/compile_cache_hit_total{entry="solve_lp_banded"}': 2.0},
+         True)
 
     ok = True
     for name, want, got in checks:

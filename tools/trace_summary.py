@@ -179,6 +179,20 @@ def _print_solves(run: List[dict], out) -> None:
         )
         if stats.get("nonfinite_count"):
             line += f" nonfinite={stats['nonfinite_count']}"
+        # adaptive-batching columns (runtime/adaptive.py): the sweep
+        # runners attach these as solve-event attrs
+        if ev.get("warm_starts"):
+            line += " warm"
+        ad = ev.get("adaptive_stats")
+        if isinstance(ad, dict):
+            line += (
+                f" adaptive[retired={ad.get('lanes_retired')}"
+                f" buckets={ad.get('buckets')}"
+                f" compile {ad.get('compile_hits')}h/"
+                f"{ad.get('compile_misses')}m]"
+            )
+        elif ev.get("adaptive"):
+            line += " adaptive"
         health = ev.get("health")
         if isinstance(health, dict):
             line += _fmt_verdict(health)
