@@ -9,6 +9,7 @@ pure gather/scatter ops, jit- and vmap-compatible over a scenario batch axis.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -45,6 +46,41 @@ class SparseLP(NamedTuple):
     l: jnp.ndarray  # (N,)
     u: jnp.ndarray  # (N,)
     c0: jnp.ndarray  # ()  (M, N recoverable from b/c shapes)
+
+
+def _hash_array(h, name: str, a) -> None:
+    """Feed one array into a running hash with its full identity: name,
+    dtype, shape, and raw bytes. Dtype and shape are part of the identity
+    on purpose — an f32 and f64 LP with equal values solve differently, so
+    they must never share a cache entry."""
+    a = np.ascontiguousarray(np.asarray(a))
+    h.update(name.encode())
+    h.update(str(a.dtype).encode())
+    h.update(repr(a.shape).encode())
+    h.update(a.tobytes())
+
+
+def _hash_options(h, options: Optional[Dict]) -> None:
+    if not options:
+        return
+    for k in sorted(options):
+        h.update(str(k).encode())
+        h.update(repr(options[k]).encode())
+
+
+def lp_fingerprint(lp, options: Optional[Dict] = None) -> str:
+    """Stable content fingerprint of a problem pytree (``LPData``,
+    ``SparseLP``, ``BandedLP`` — any NamedTuple of arrays) plus the solver
+    options that shape the answer. Two calls agree iff every field is
+    byte-identical (same values, dtype, AND shape) and the options match —
+    the dedup key for sweeps and the result-cache key of ``serve/``
+    (`docs/serving.md`). Host-side only; device arrays are pulled once."""
+    h = hashlib.sha256()
+    h.update(type(lp).__name__.encode())
+    for name, arr in zip(lp._fields, lp):
+        _hash_array(h, name, arr)
+    _hash_options(h, options)
+    return h.hexdigest()
 
 
 @dataclasses.dataclass
@@ -247,6 +283,37 @@ class CompiledLP:
 
         self.has_param_A = bool(self.A_pgroups)
         return self
+
+    # ------------------------------------------------------------------
+    def fingerprint(self, params: Optional[Dict] = None, options: Optional[Dict] = None) -> str:
+        """Stable content hash of the lowered program: every static index /
+        scale array, the parametric groups, bounds, and the objective sense.
+        Two models that lower to byte-identical programs share a
+        fingerprint regardless of how they were built. With `params` (and
+        optionally solver `options`) the hash covers the *instantiated*
+        problem too — equal to hashing structure + parameter values without
+        materializing the LP tensors, which is what the serve result cache
+        wants for `CompiledLP`-form requests."""
+        h = hashlib.sha256()
+        h.update(b"CompiledLP")
+        h.update(repr(sorted(self.param_shapes.items())).encode())
+        h.update(repr((self.M, self.N, self.n_orig, self.n_slack, self.obj_sense)).encode())
+        for name in ("A_rows", "A_cols", "A_vals", "b_rows", "b_vals",
+                     "c_cols", "c_vals", "lb", "ub", "_keep_cols",
+                     "_fixed_vals"):
+            _hash_array(h, name, getattr(self, name))
+        h.update(repr(self.c0_val).encode())
+        for label, groups in (("A", self.A_pgroups), ("b", self.b_pgroups),
+                              ("c", self.c_pgroups), ("c0", self.c0_pgroups)):
+            for k in sorted(groups):
+                h.update(f"{label}:{k}".encode())
+                for i, arr in enumerate(groups[k]):
+                    _hash_array(h, str(i), arr)
+        if params is not None:
+            for k in sorted(params):
+                _hash_array(h, f"param:{k}", params[k])
+        _hash_options(h, options)
+        return h.hexdigest()
 
     # ------------------------------------------------------------------
     def instantiate(self, params: Dict[str, jnp.ndarray], dtype=None) -> LPData:

@@ -56,10 +56,15 @@ CYCLE_WINDOW = 12  # cycling: tail length inspected for periodicity
 CYCLE_RTOL = 0.05  # cycling: relative match tolerance at lag p
 CYCLE_AMP = 0.10  # cycling: minimum relative amplitude (flat != cycling)
 
-# severity order: index = badness (worst-offender selection, footers)
+# severity order: index = badness (worst-offender selection, footers).
+# deadline_exceeded/shed are SERVICE verdicts (dispatches_tpu.serve):
+# the solve itself may be fine but the answer was late (best-iterate
+# returned) or never attempted (load shed) — worse than any converged-
+# but-ugly trajectory, better than a solver breakdown.
 SEVERITY = (
-    "healthy", "slow", "cycling", "stalled", "diverged", "nonfinite",
-    "hang", "failed",
+    "healthy", "slow", "cycling", "stalled",
+    "deadline_exceeded", "shed",
+    "diverged", "nonfinite", "hang", "failed",
 )
 
 # trajectory fields in blame-precedence order: residuals first (what the

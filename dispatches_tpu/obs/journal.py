@@ -285,10 +285,16 @@ class Tracer:
         try:
             from . import health as _health
 
-            summary = _health.health_summary(sol, trace=trace)
-            if summary is not None:
-                rec["health"] = summary
-                _health.note_verdicts(summary, solve=name)
+            if "health" in rec:
+                # caller supplied its own summary (e.g. the serve layer,
+                # where a deadline_exceeded verdict is decided by the
+                # service, not the trajectory) — count it, don't recompute
+                _health.note_verdicts(rec["health"], solve=name)
+            else:
+                summary = _health.health_summary(sol, trace=trace)
+                if summary is not None:
+                    rec["health"] = summary
+                    _health.note_verdicts(summary, solve=name)
         except Exception as e:  # diagnosis must never kill the run
             rec["health_error"] = f"{type(e).__name__}: {e}"
         self._emit(rec)

@@ -149,6 +149,31 @@ class MetricsRegistry:
                 },
             }
 
+    def histogram_quantile(
+        self, name: str, q: float, **labels: Any
+    ) -> Optional[float]:
+        """Approximate the q-quantile (0..1) of a histogram series from
+        its bucket counts — Prometheus-style linear interpolation within
+        the containing bucket (lower edge 0 for the first). Observations
+        in the +Inf tail clamp to the largest finite bound; returns None
+        for an unknown or empty series. Good enough for latency SLO
+        reporting (p50/p95/p99), not for exact statistics."""
+        key = _series_key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None or h.count == 0:
+                return None
+            rank = q * h.count
+            cum = 0.0
+            for i, b in enumerate(h.buckets):
+                prev = cum
+                cum += h.counts[i]
+                if cum >= rank:
+                    lo = h.buckets[i - 1] if i else 0.0
+                    frac = (rank - prev) / h.counts[i] if h.counts[i] else 0.0
+                    return lo + (b - lo) * frac
+            return h.buckets[-1] if h.buckets else None
+
     def flat_values(self) -> Dict[str, float]:
         """Monotone series as one flat {series: value} dict — counters plus
         per-histogram ``_count``/``_sum`` — the delta basis for the
@@ -250,6 +275,10 @@ def observe(
     **labels: Any,
 ) -> None:
     _REGISTRY.observe(name, value, buckets, **labels)
+
+
+def histogram_quantile(name: str, q: float, **labels: Any) -> Optional[float]:
+    return _REGISTRY.histogram_quantile(name, q, **labels)
 
 
 def snapshot() -> Dict[str, Dict[str, Any]]:

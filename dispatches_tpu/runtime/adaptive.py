@@ -304,6 +304,372 @@ def _jnp_tree(tree):
 
 
 # ---------------------------------------------------------------------------
+# segment factories: the (seg_cold, seg_resume) pair each solver family
+# contributes to the chunked drivers. `stop_axis=None` broadcasts one
+# scalar stop mark to every lane (the compaction driver below);
+# `stop_axis=0` maps a per-lane stop array — what the continuous-batching
+# SlotEngine needs, since lanes admitted at different times sit at
+# different iteration counts inside ONE executable. Either way the stop
+# mark only decides where the host observes; the per-lane iterate
+# sequence — and therefore the solution bits — never depends on it.
+
+
+def dense_segments(d_axes, w_ax, trace, solver_kw, stop_axis=None):
+    # the segments are jitted as a whole (not just the inner solver):
+    # an eager vmap-of-jit re-runs the batching trace on EVERY call —
+    # ~10ms/chunk of host overhead that dominates small-LP serving
+    import jax
+
+    from ..solvers.ipm import solve_lp_partial
+
+    @jax.jit
+    def seg_cold(d, w, stop):
+        return jax.vmap(
+            lambda d_, w_, s_: solve_lp_partial(
+                d_, warm_start=w_, it_stop=s_, trace=trace, **solver_kw
+            ),
+            in_axes=(d_axes, w_ax, stop_axis),
+        )(d, w, stop)
+
+    @jax.jit
+    def seg_resume(d, s, stop):
+        return jax.vmap(
+            lambda d_, s_, stop_: solve_lp_partial(
+                d_, state=s_, it_stop=stop_, trace=trace, **solver_kw
+            ),
+            in_axes=(d_axes, 0, stop_axis),
+        )(d, s, stop)
+
+    return seg_cold, seg_resume
+
+
+def banded_segments(meta, d_axes, w_ax, trace, solver_kw, stop_axis=None):
+    import jax
+
+    from ..solvers.structured import solve_lp_banded
+
+    def _drop_tr(out):
+        return (out[0], out[2]) if trace else out
+
+    @jax.jit
+    def seg_cold(d, w, stop):
+        return jax.vmap(
+            lambda d_, w_, s_: _drop_tr(solve_lp_banded(
+                meta, d_, warm_start=w_, it_stop=s_, trace=trace,
+                return_state=True, **solver_kw
+            )),
+            in_axes=(d_axes, w_ax, stop_axis),
+        )(d, w, stop)
+
+    @jax.jit
+    def seg_resume(d, s, stop):
+        return jax.vmap(
+            lambda d_, s_, stop_: _drop_tr(solve_lp_banded(
+                meta, d_, state=s_, it_stop=stop_, trace=trace,
+                return_state=True, **solver_kw
+            )),
+            in_axes=(d_axes, 0, stop_axis),
+        )(d, s, stop)
+
+    return seg_cold, seg_resume
+
+
+def pdhg_segments(d_axes, w_ax, trace, solver_kw, stop_axis=None):
+    import jax
+
+    from ..solvers.pdhg import solve_lp_pdhg
+
+    def _drop_tr(out):
+        return (out[0], out[2]) if trace else out
+
+    @jax.jit
+    def seg_cold(d, w, stop):
+        return jax.vmap(
+            lambda d_, w_, s_: _drop_tr(solve_lp_pdhg(
+                d_, warm_start=w_, it_stop=s_, trace=trace,
+                return_state=True, **solver_kw
+            )),
+            in_axes=(d_axes, w_ax, stop_axis),
+        )(d, w, stop)
+
+    @jax.jit
+    def seg_resume(d, s, stop):
+        return jax.vmap(
+            lambda d_, s_, stop_: _drop_tr(solve_lp_pdhg(
+                d_, state=s_, it_stop=stop_, trace=trace,
+                return_state=True, **solver_kw
+            )),
+            in_axes=(d_axes, 0, stop_axis),
+        )(d, s, stop)
+
+    return seg_cold, seg_resume
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: slot refill instead of compaction
+
+
+class SlotEngine:
+    """Fixed-bucket continuous-batching driver (the serve/ slot-refill
+    hook). Where `_adaptive_drive` COMPACTS to a smaller bucket when lanes
+    retire, this engine keeps the bucket size constant and BACK-FILLS
+    freed slots with new problems between chunks — the model-server
+    pattern (continuous batching) rather than the offline-sweep pattern:
+    under sustained load there is always fresh work, so shrinking the
+    batch would only cold-start a different executable while requests
+    queue. One executable pair (cold-init at stop=0 + per-lane-stop
+    resume) serves the service's whole lifetime.
+
+    Mechanics per `step()`:
+
+    1. newly admitted slots get their cold loop state by running the
+       cold-init executable at ``it_stop=0`` (zero iterations — one cheap
+       dispatch) and scattering just their rows into the carried state;
+    2. every active slot resumes with its own stop mark
+       ``min(it + chunk_iters, max_iter)`` (idle/padding slots get stop 0
+       and stay frozen under the vmapped `while_loop`'s select);
+    3. finished lanes (``done_flag``) are harvested and their slots freed.
+
+    Identity contract, verified in tests/test_serve.py: because a lane's
+    iterate sequence depends only on its own LP data and the bucket size
+    (companion rows and slot position never mix in — there is no
+    cross-lane reduction anywhere in the solvers), a lane harvested here
+    is BITWISE identical to the same lane in a one-shot
+    ``solve_lp_batch`` of `bucket` lanes, no matter when it was admitted
+    or what shared its batch. (Matching the *unbatched* ``solve_lp`` is
+    not promised on CPU — the batched-LAPACK rounding caveat in the
+    module docstring.)
+
+    `fields` is the problem NamedTuple class (LPData/BandedLP/SparseLP);
+    `shared` maps field name -> array for fields broadcast across lanes
+    (e.g. one sparsity pattern for PDHG); every other field is stacked
+    per-slot from the admitted rows.
+    """
+
+    def __init__(
+        self,
+        entry: str,
+        fields,
+        seg_cold,
+        seg_resume,
+        bucket: int,
+        *,
+        chunk_iters: int = 8,
+        max_iter: int = 60,
+        done_flag=None,
+        shared: Optional[dict] = None,
+        trace: bool = False,
+        opt_key=(),
+    ):
+        if bucket <= 0:
+            raise ValueError(f"bucket must be positive (got {bucket})")
+        self.entry = entry
+        self.fields = fields
+        self.seg_cold = seg_cold
+        self.seg_resume = seg_resume
+        self.bucket = bucket
+        self.chunk_iters = int(chunk_iters)
+        self.max_iter = int(max_iter)
+        self.shared = dict(shared or {})
+        self.trace = trace
+        self.opt_key = opt_key
+        self._custom_done = done_flag is not None
+        self._done_flag = done_flag or (
+            lambda st: np.asarray(st.done) | (np.asarray(st.it) >= self.max_iter)
+        )
+        self._tokens = [None] * bucket  # slot -> caller token (None = idle)
+        self._fresh = [False] * bucket  # needs cold state before next resume
+        self._st = None  # carried device state pytree
+        self._d_cur = None  # cached stacked device data
+        self._dirty = True  # no stacked data yet; full build on first step
+        # host mirror of per-lane iteration counts: surviving lanes always
+        # run exactly to their stop mark (done lanes are harvested), so the
+        # next chunk's stops are computable without a device->host read
+        self._it_mark = np.zeros(bucket, np.int32)
+        self.chunks = 0
+        self.refills = 0
+
+    # -- slot management ----------------------------------------------
+    def free_slots(self) -> int:
+        return sum(t is None for t in self._tokens)
+
+    def active(self) -> list:
+        return [t for t in self._tokens if t is not None]
+
+    def admit(self, token, row) -> int:
+        """Place one problem (`row`: the problem NamedTuple holding ONE
+        lane's unbatched fields; `shared` fields may be None/ignored) into
+        a free slot. Returns the slot index; raises when full."""
+        for i, t in enumerate(self._tokens):
+            if t is None:
+                self._tokens[i] = token
+                row_np = tuple(
+                    None if name in self.shared else np.asarray(a)
+                    for name, a in zip(self.fields._fields, row)
+                )
+                if self._buf is None:
+                    # allocate the persistent host mirror, every slot
+                    # seeded with this first row (dup-padding semantics:
+                    # idle slots hold finite frozen data, stop mark 0)
+                    self._buf = [
+                        None if r is None
+                        else np.broadcast_to(
+                            r, (self.bucket,) + r.shape
+                        ).copy()
+                        for r in row_np
+                    ]
+                for buf, r in zip(self._buf, row_np):
+                    if buf is not None:
+                        buf[i] = r
+                self._fresh[i] = True
+                self._it_mark[i] = 0
+                self._dirty = True
+                if self._st is not None:
+                    self.refills += 1
+                return i
+        raise RuntimeError("SlotEngine.admit on a full bucket")
+
+    def evict(self, token):
+        """Pull an in-flight lane out mid-solve and return its
+        best-iterate-so-far solution row (the graceful-degradation path:
+        deadline enforcement harvests what the solver had). Returns None
+        when the lane has not run a single chunk yet."""
+        i = self._tokens.index(token)
+        out = None
+        if self._sol_dev is not None and not self._fresh[i]:
+            sol_np = self._sol_rows()
+            out = self.fields_sol(*(leaf[i] for leaf in sol_np))
+        self._release(i)
+        return out
+
+    def _release(self, i: int) -> None:
+        # the released slot's device data stays in place as finite padding
+        # (its stop mark goes to 0, so it is frozen); no restack needed
+        self._tokens[i] = None
+        self._fresh[i] = False
+
+    # -- the chunk step ------------------------------------------------
+    _sol_dev = None  # last chunk's on-device solution tree
+    _sol_np_cache = None  # host copy, materialized on first use per chunk
+    _scatter_fn = None
+    _buf = None  # persistent (bucket, ...) host mirror of the lane data
+    _zero_stops = None
+    fields_sol = tuple  # set by step() from the first harvested solution
+
+    def _sol_rows(self):
+        """Host copy of the last chunk's solution tree (cached — at most
+        one device->host transfer per chunk, and none on chunks where
+        nothing retires, evicts, or asks)."""
+        if self._sol_np_cache is None:
+            self._sol_np_cache = _np_tree(self._sol_dev)
+        return self._sol_np_cache
+
+    def _scatter(self):
+        # compiled once per engine: rows of `new` where sel, else `old` —
+        # keeps the carried state on device (the numpy round-trip scatter
+        # cost more per chunk than the solve segment itself)
+        if self._scatter_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def _sc(old, new, sel):
+                return jax.tree.map(
+                    lambda a, b: jnp.where(
+                        sel.reshape(sel.shape + (1,) * (a.ndim - 1)), b, a
+                    ),
+                    old, new,
+                )
+
+            self._scatter_fn = jax.jit(_sc)
+        return self._scatter_fn
+
+    def _stack(self):
+        import jax.numpy as jnp
+
+        # one flat transfer per field from the persistent host mirror
+        # (admit writes rows into the mirror in place, so this costs the
+        # same whether one lane changed or all of them did)
+        return self.fields(*(
+            self.shared[name] if name in self.shared else jnp.asarray(buf)
+            for name, buf in zip(self.fields._fields, self._buf)
+        ))
+
+    def step(self) -> list:
+        """Run one chunk over the occupied slots. Returns the harvested
+        ``(token, solution_row, lane_stats)`` triples (possibly empty);
+        `lane_stats` carries the lane's iteration count and chunk count.
+        No-op returning [] when every slot is idle."""
+        import jax.numpy as jnp
+
+        if not any(t is not None for t in self._tokens):
+            return []
+        if self._dirty:
+            self._d_cur = self._stack()
+            self._dirty = False
+        occupied = np.asarray([t is not None for t in self._tokens])
+
+        if any(self._fresh):
+            _note_compile((self.entry, self.bucket, "cold", self.trace,
+                           self.opt_key))
+            if self._zero_stops is None:
+                self._zero_stops = jnp.zeros((self.bucket,), jnp.int32)
+            _, st0 = self.seg_cold(self._d_cur, None, self._zero_stops)
+            # the very first chunk routes through the same scatter as
+            # every later one (sel = all rows), so the carried tree's
+            # avals never change and resume compiles exactly once
+            base = st0 if self._st is None else self._st
+            sel = jnp.asarray(
+                np.ones(self.bucket, bool) if self._st is None
+                else np.asarray(self._fresh)
+            )
+            self._st = self._scatter()(base, st0, sel)
+            self._fresh = [False] * self.bucket
+
+        # stops come from the host iteration marks, not a device read:
+        # every surviving lane ran exactly to its previous stop (done lanes
+        # were harvested, fresh lanes reset to 0 by the cold scatter)
+        stops = np.where(
+            occupied,
+            np.minimum(self._it_mark + self.chunk_iters, self.max_iter),
+            0,
+        ).astype(np.int32)
+        _note_compile((self.entry, self.bucket, "resume", self.trace,
+                       self.opt_key))
+        sol, st = self.seg_resume(self._d_cur, self._st, jnp.asarray(stops))
+        self._st = st
+        self._it_mark = stops
+        self.chunks += 1
+        self._sol_dev = sol
+        self._sol_np_cache = None
+        self.fields_sol = type(sol)
+        its = None
+        if self._custom_done:
+            finished = np.asarray(self._done_flag(st))
+        else:
+            its = np.asarray(st.it)
+            finished = np.asarray(st.done) | (its >= self.max_iter)
+
+        out = []
+        retired = 0
+        if finished.any():
+            sol_np = self._sol_rows()
+            if its is None:
+                its = np.asarray(st.it)
+            for i, token in enumerate(self._tokens):
+                if token is None or not finished[i]:
+                    continue
+                row = type(sol)(*(leaf[i] for leaf in sol_np))
+                out.append((token, row, {"iterations": int(its[i])}))
+                self._release(i)
+                retired += 1
+        if retired:
+            obs_metrics.inc(
+                "adaptive_lanes_retired_total", retired, entry=self.entry
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
 # entry points
 
 

@@ -1,0 +1,35 @@
+"""In-process asynchronous dispatch service (continuous batching).
+
+Accepts solve requests — a `CompiledLP` + params or a prebuilt problem
+row — queues them with priority classes and per-request deadlines, and
+micro-batches them onto the runtime's fixed-bucket `SlotEngine`:
+retired lanes' slots are back-filled from the queue between chunks, so
+the device executables stay hot under sustained load. Admission control
+sheds lowest-priority work when the bounded queue overflows; deadline
+enforcement returns the best iterate so far with a
+``deadline_exceeded`` verdict; a fingerprint-keyed LRU cache returns
+previously solved answers bitwise. See `docs/serving.md`.
+"""
+
+from .cache import ResultCache
+from .queue import AdmissionQueue
+from .request import (
+    PRIORITY_CLASSES,
+    SolveRequest,
+    SolveResult,
+    Ticket,
+    priority_value,
+)
+from .service import DispatchService, make_dense_service
+
+__all__ = [
+    "AdmissionQueue",
+    "DispatchService",
+    "PRIORITY_CLASSES",
+    "ResultCache",
+    "SolveRequest",
+    "SolveResult",
+    "Ticket",
+    "make_dense_service",
+    "priority_value",
+]

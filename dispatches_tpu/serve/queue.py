@@ -1,0 +1,65 @@
+"""Bounded priority queue with load-shedding admission control.
+
+Ordering is ``(priority, seq)`` — strict priority classes, FIFO within a
+class. When the queue is full, admission control compares the newcomer
+against the WORST pending request: a more-urgent newcomer displaces it
+(the displaced request is shed — lowest priority goes first, per the
+backpressure contract), an equal-or-less-urgent newcomer is itself
+rejected. Either way exactly one request is shed and the bound holds.
+
+Kept as a sorted list: admission/shedding needs both ends plus arbitrary
+removal (deadline expiry), and service queues are bounded-small by
+design, so O(n) inserts beat heap bookkeeping for clarity.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import List, Optional, Tuple
+
+from .request import SolveRequest
+
+
+class AdmissionQueue:
+    def __init__(self, limit: int = 64):
+        if limit <= 0:
+            raise ValueError(f"queue limit must be positive (got {limit})")
+        self.limit = int(limit)
+        self._q: List[Tuple[tuple, SolveRequest]] = []
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __iter__(self):
+        return (req for _, req in self._q)
+
+    def push(
+        self, req: SolveRequest
+    ) -> Tuple[bool, Optional[SolveRequest]]:
+        """Try to enqueue. Returns ``(admitted, shed)``: `shed` is the
+        displaced lowest-priority request when the newcomer bumped one
+        out, or `req` itself when it was rejected at the door."""
+        if len(self._q) < self.limit:
+            bisect.insort(self._q, (req.sort_key(), req))
+            return True, None
+        worst_key, worst = self._q[-1]
+        if req.sort_key() < worst_key:
+            self._q.pop()
+            bisect.insort(self._q, (req.sort_key(), req))
+            return True, worst
+        return False, req
+
+    def pop(self) -> Optional[SolveRequest]:
+        """Most-urgent pending request, or None when empty."""
+        if not self._q:
+            return None
+        return self._q.pop(0)[1]
+
+    def remove_expired(self, now: float) -> List[SolveRequest]:
+        """Pull out every pending request whose deadline has passed (they
+        never reach a solver slot; the service resolves them as
+        ``deadline_exceeded`` with no solution)."""
+        expired = [(k, r) for k, r in self._q if r.expired(now)]
+        if expired:
+            self._q = [(k, r) for k, r in self._q if not r.expired(now)]
+        return [r for _, r in expired]

@@ -1,0 +1,386 @@
+"""The in-process asynchronous dispatch service.
+
+`DispatchService` glues the pieces together: callers `submit()` problem
+rows (or `submit_compiled()` a `CompiledLP` + params) and get a
+`Ticket`; requests flow fingerprint-cache -> admission queue ->
+`SlotEngine` slots, and completions resolve tickets with numpy-leaf
+`SolveResult`s. The solve loop is the engine's continuous batching: one
+fixed-bucket executable pair stays hot while retired lanes' slots are
+back-filled from the queue between chunks.
+
+Two driving modes share one deterministic core:
+
+- `pump()` runs exactly one cycle (expire queued -> refill slots -> one
+  chunk -> harvest -> enforce in-flight deadlines). Tests drive it under
+  a fake clock; batch callers loop it via `drain()`.
+- `start()` runs `pump()` on a background thread until `stop()` —
+  the serving mode `tools/serve_dispatch.py` and `tools/loadgen.py` use.
+
+Time is injectable (`clock=`, default `time.monotonic`); request
+deadlines live in that clock's domain. Everything the service decides is
+observable: `serve_*` counters/gauges/latency histograms through
+`obs.metrics`, and shed / deadline / completion records through the
+process tracer's journal (`obs.journal.get_tracer()`), with service
+verdicts (``shed``, ``deadline_exceeded``) flowing into the same
+`solve_verdict_total` counters the solver health engine uses.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..obs import health as obs_health
+from ..obs import metrics as obs_metrics
+from ..obs.journal import get_tracer
+from .cache import ResultCache
+from .queue import AdmissionQueue
+from .request import SolveRequest, SolveResult, Ticket, priority_value
+
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def _service_health(verdict: str, detail: str) -> dict:
+    """A health record in `obs.health.health_summary` shape for verdicts
+    the SERVICE decides (the trajectory may look fine — the answer was
+    late or never attempted)."""
+    v = obs_health.Verdict(verdict, None, None, detail)
+    return {
+        "counts": {verdict: 1},
+        "n_bad": 0 if verdict == "healthy" else 1,
+        "worst": {"lane": 0, **v._asdict()},
+        "verdicts": [v._asdict()],
+    }
+
+
+class DispatchService:
+    def __init__(
+        self,
+        engine,
+        *,
+        queue_limit: int = 64,
+        cache: Optional[ResultCache] = None,
+        clock=time.monotonic,
+        name: str = "serve",
+    ):
+        self.engine = engine
+        self.queue = AdmissionQueue(queue_limit)
+        self.cache = cache
+        self.clock = clock
+        self.name = name
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self.completed = 0
+        self.shed_total = 0
+        self.deadline_total = 0
+
+    # -- submission ----------------------------------------------------
+    def submit(
+        self,
+        problem: Any,
+        *,
+        priority="normal",
+        deadline: Optional[float] = None,
+        timeout: Optional[float] = None,
+        fingerprint: Optional[str] = None,
+        options: Optional[Dict] = None,
+        request_id: Optional[str] = None,
+    ) -> Ticket:
+        """Queue one problem row. `timeout` is seconds-from-now sugar for
+        an absolute `deadline`. The returned ticket may already be done:
+        cache hits and admission-shed requests resolve synchronously."""
+        now = self.clock()
+        if deadline is None and timeout is not None:
+            deadline = now + timeout
+        req = SolveRequest(
+            problem,
+            priority=priority_value(priority),
+            deadline=deadline,
+            fingerprint=self._fingerprint(problem, fingerprint, options),
+            request_id=request_id,
+        )
+        ticket = Ticket(req)
+        with self._lock:
+            req.seq = self._seq
+            self._seq += 1
+            req.submitted_at = now
+            if self.cache is not None:
+                hit = self.cache.get(req.fingerprint)
+                if hit is not None:
+                    self._resolve_cached(req, hit, now)
+                    return ticket
+            admitted, shed = self.queue.push(req)
+            if shed is not None:
+                self._resolve_shed(shed)
+            obs_metrics.set_gauge("serve_queue_depth", len(self.queue))
+        return ticket
+
+    def submit_compiled(
+        self, compiled, params: Dict, *, dtype=None, options=None, **kw
+    ) -> Ticket:
+        """Instantiate a `CompiledLP` at `params` and submit the result;
+        the cache key is `compiled.fingerprint(params, ...)` so repeated
+        submissions of the same params never re-instantiate bits."""
+        fp = kw.pop("fingerprint", None)
+        if fp is None and self.cache is not None:
+            fp = compiled.fingerprint(
+                params, options=self._fp_options(options)
+            )
+        lp = compiled.instantiate(params, dtype=dtype)
+        return self.submit(lp, fingerprint=fp, options=options, **kw)
+
+    def _fp_options(self, options: Optional[Dict]) -> Dict:
+        # solver identity + bucket belong in the cache key: the same bytes
+        # under different tolerances — or a different batch width on CPU
+        # LAPACK — are different answers
+        out = dict(options or {})
+        out["_serve"] = (self.engine.entry, self.engine.bucket,
+                         self.engine.opt_key)
+        return out
+
+    def _fingerprint(self, problem, fingerprint, options) -> Optional[str]:
+        if fingerprint is not None or self.cache is None:
+            return fingerprint
+        from ..core.program import lp_fingerprint
+
+        try:
+            return lp_fingerprint(problem, options=self._fp_options(options))
+        except Exception:
+            return None  # unhashable problem: solve uncached, don't refuse
+
+    # -- the cycle -----------------------------------------------------
+    def pump(self) -> int:
+        """One deterministic service cycle; returns completions resolved
+        this cycle. Safe to call with nothing to do."""
+        done = 0
+        with self._lock:
+            now = self.clock()
+            for req in self.queue.remove_expired(now):
+                self._resolve_deadline(req, solution=None, iterations=None)
+                done += 1
+            while self.engine.free_slots() and len(self.queue):
+                req = self.queue.pop()
+                req.started_at = now
+                self.engine.admit(req, req.problem)
+            if self.engine.active():
+                for req, row, stats in self.engine.step():
+                    self._resolve_solved(req, row, stats)
+                    done += 1
+                now = self.clock()
+                for req in [
+                    r for r in self.engine.active() if r.expired(now)
+                ]:
+                    row = self.engine.evict(req)
+                    self._resolve_deadline(
+                        req, solution=row,
+                        iterations=None if row is None
+                        else int(row.iterations),
+                    )
+                    done += 1
+            obs_metrics.set_gauge("serve_queue_depth", len(self.queue))
+            obs_metrics.set_gauge(
+                "serve_active_lanes", len(self.engine.active())
+            )
+        return done
+
+    def drain(self, max_cycles: int = 10_000) -> int:
+        """Pump until queue and slots are empty; returns completions."""
+        total = 0
+        for _ in range(max_cycles):
+            if not len(self.queue) and not self.engine.active():
+                return total
+            total += self.pump()
+        raise RuntimeError(f"drain did not converge in {max_cycles} cycles")
+
+    # -- background mode -----------------------------------------------
+    def start(self, idle_sleep: float = 0.001) -> None:
+        if self._thread is not None:
+            raise RuntimeError("service already started")
+        self._stop_evt.clear()
+
+        def _loop():
+            while not self._stop_evt.is_set():
+                with self._lock:
+                    busy = len(self.queue) or self.engine.active()
+                if busy:
+                    self.pump()
+                else:
+                    self._stop_evt.wait(idle_sleep)
+
+        self._thread = threading.Thread(
+            target=_loop, name="dispatch-serve", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True) -> None:
+        if self._thread is None:
+            return
+        if drain:
+            while True:
+                with self._lock:
+                    busy = len(self.queue) or self.engine.active()
+                if not busy:
+                    break
+                time.sleep(0.001)
+        self._stop_evt.set()
+        self._thread.join()
+        self._thread = None
+
+    # -- completions ---------------------------------------------------
+    def _resolve_cached(self, req, hit: SolveResult, now: float) -> None:
+        self.completed += 1
+        latency = self.clock() - now
+        obs_metrics.inc("serve_requests_total", status="cached")
+        obs_metrics.observe(
+            "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
+            status="cached",
+        )
+        req.ticket._complete(hit._replace(
+            from_cache=True, latency=latency, request_id=req.request_id,
+        ))
+
+    def _resolve_solved(self, req, row, stats: dict) -> None:
+        self.completed += 1
+        now = self.clock()
+        latency = now - req.submitted_at
+        verdicts = obs_health.classify_solution(row)
+        verdict = verdicts[0].verdict if verdicts else "healthy"
+        result = SolveResult(
+            solution=row,
+            verdict=verdict,
+            iterations=stats.get("iterations"),
+            latency=latency,
+            request_id=req.request_id,
+        )
+        if self.cache is not None:
+            self.cache.put(req.fingerprint, result)
+        obs_metrics.inc("serve_requests_total", status="ok")
+        obs_metrics.observe(
+            "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
+            status="ok",
+        )
+        get_tracer().solve_event(
+            self.name, row,
+            request_id=req.request_id, seq=req.seq,
+            latency_s=latency, iterations=stats.get("iterations"),
+        )
+        req.ticket._complete(result)
+
+    def _resolve_deadline(self, req, solution, iterations) -> None:
+        self.completed += 1
+        self.deadline_total += 1
+        latency = self.clock() - req.submitted_at
+        obs_metrics.inc("serve_requests_total", status="deadline_exceeded")
+        obs_metrics.inc("serve_deadline_total")
+        obs_metrics.observe(
+            "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
+            status="deadline_exceeded",
+        )
+        detail = (
+            "deadline passed mid-solve; best iterate returned"
+            if solution is not None
+            else "deadline passed before the first chunk; no iterate"
+        )
+        if solution is not None:
+            get_tracer().solve_event(
+                self.name, solution,
+                request_id=req.request_id, seq=req.seq,
+                latency_s=latency, iterations=iterations,
+                health=_service_health("deadline_exceeded", detail),
+            )
+        else:
+            get_tracer().event(
+                "serve_deadline", verdict="deadline_exceeded",
+                request_id=req.request_id, seq=req.seq, detail=detail,
+            )
+            obs_health.note_verdicts(
+                {"deadline_exceeded": 1}, solve=self.name
+            )
+        req.ticket._complete(SolveResult(
+            solution=solution,
+            verdict="deadline_exceeded",
+            iterations=iterations,
+            latency=latency,
+            request_id=req.request_id,
+        ))
+
+    def _resolve_shed(self, req) -> None:
+        self.completed += 1
+        self.shed_total += 1
+        obs_metrics.inc("serve_requests_total", status="shed")
+        obs_metrics.inc("serve_shed_total")
+        get_tracer().event(
+            "serve_shed", verdict="shed",
+            request_id=req.request_id, seq=req.seq, priority=req.priority,
+        )
+        obs_health.note_verdicts({"shed": 1}, solve=self.name)
+        req.ticket._complete(SolveResult(
+            solution=None,
+            verdict="shed",
+            latency=self.clock() - req.submitted_at,
+            request_id=req.request_id,
+        ))
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "queue_depth": len(self.queue),
+                "active_lanes": len(self.engine.active()),
+                "free_slots": self.engine.free_slots(),
+                "bucket": self.engine.bucket,
+                "chunks": self.engine.chunks,
+                "refills": self.engine.refills,
+                "completed": self.completed,
+                "shed": self.shed_total,
+                "deadline_exceeded": self.deadline_total,
+            }
+            if self.cache is not None:
+                out["cache"] = self.cache.stats()
+            for status in ("ok", "cached"):
+                for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                    v = obs_metrics.histogram_quantile(
+                        "serve_latency_seconds", q, status=status
+                    )
+                    if v is not None:
+                        out[f"latency_{tag}_{status}"] = v
+            return out
+
+
+def make_dense_service(
+    bucket: int,
+    *,
+    chunk_iters: int = 8,
+    queue_limit: int = 64,
+    cache_size: Optional[int] = 256,
+    clock=time.monotonic,
+    trace: bool = False,
+    **solver_kw,
+) -> DispatchService:
+    """A `DispatchService` over dense `LPData` rows solved by the IPM:
+    one `SlotEngine` at `bucket` lanes, solver options passed through to
+    `solve_lp_partial` (`max_iter` also bounds the engine's per-lane
+    budget). Every submitted row must share shapes (M, N)."""
+    from ..core.program import LPData
+    from ..runtime.adaptive import SlotEngine, _opt_key, dense_segments
+
+    solver_kw.setdefault("max_iter", 60)
+    d_axes = LPData(*(0,) * len(LPData._fields))
+    seg_cold, seg_resume = dense_segments(
+        d_axes, None, trace, solver_kw, stop_axis=0
+    )
+    engine = SlotEngine(
+        "serve_dense", LPData, seg_cold, seg_resume, bucket,
+        chunk_iters=chunk_iters, max_iter=solver_kw["max_iter"],
+        trace=trace, opt_key=_opt_key(solver_kw),
+    )
+    cache = ResultCache(cache_size) if cache_size else None
+    return DispatchService(
+        engine, queue_limit=queue_limit, cache=cache, clock=clock,
+    )

@@ -674,13 +674,14 @@ class TestWatchdog:
         assert "Thread" in hang["stacks"]
         assert "test_obs_health" in hang["stacks"]
 
-    def test_tools_shim_still_exports(self):
-        # bench_year_grad.py / measure_matmul_peak.py import via the shim
-        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
-                                        "tools"))
+    def test_tools_shim_removed(self):
+        # the PR-3 back-compat shim is gone; everything imports the
+        # package module directly now
+        tools_dir = os.path.join(os.path.dirname(__file__), "..", "tools")
+        assert not os.path.exists(os.path.join(tools_dir, "_watchdog.py"))
+        sys.path.insert(0, tools_dir)
         try:
-            shim = importlib.import_module("_watchdog")
-            assert shim.with_watchdog is with_watchdog
-            assert shim.WatchdogTimeout is WatchdogTimeout
+            with pytest.raises(ImportError):
+                importlib.import_module("_watchdog")
         finally:
             sys.path.pop(0)

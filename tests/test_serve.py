@@ -1,0 +1,423 @@
+"""Serve subsystem: content fingerprints, the continuous-batching
+SlotEngine, queue semantics under a fake clock, the result cache's
+bitwise contract, obs wiring, and the hardened trace_summary renderer."""
+import importlib
+import io
+import json
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData, lp_fingerprint
+from dispatches_tpu.obs.journal import Tracer, read_journal, use_tracer
+from dispatches_tpu.obs.metrics import (
+    MetricsRegistry,
+    reset_metrics,
+)
+from dispatches_tpu.runtime.adaptive import SlotEngine, dense_segments
+from dispatches_tpu.serve import (
+    AdmissionQueue,
+    ResultCache,
+    SolveRequest,
+    make_dense_service,
+)
+from dispatches_tpu.solvers.ipm import solve_lp_batch
+
+
+def _lp(seed, n=6, m=3, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    return LPData(
+        jnp.asarray(A, dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------
+# satellite: content fingerprints
+# ---------------------------------------------------------------------
+class TestFingerprint:
+    def test_stable_across_rebuilds(self):
+        assert lp_fingerprint(_lp(0)) == lp_fingerprint(_lp(0))
+
+    def test_value_sensitivity(self):
+        lp = _lp(0)
+        bumped = lp._replace(c=lp.c.at[0].add(1e-12))
+        assert lp_fingerprint(lp) != lp_fingerprint(bumped)
+
+    def test_dtype_is_part_of_identity(self):
+        # an f32 and an f64 instance must never share a cache entry even
+        # when the f32 values round-trip exactly
+        lp64 = LPData(*(jnp.asarray(np.asarray(a, np.float32), jnp.float64)
+                        for a in _lp(1)))
+        lp32 = LPData(*(jnp.asarray(np.asarray(a), jnp.float32)
+                        for a in lp64))
+        assert np.allclose(np.asarray(lp64.A), np.asarray(lp32.A))
+        assert lp_fingerprint(lp64) != lp_fingerprint(lp32)
+
+    def test_options_and_order(self):
+        lp = _lp(2)
+        assert (lp_fingerprint(lp, options={"tol": 1e-8, "it": 60})
+                == lp_fingerprint(lp, options={"it": 60, "tol": 1e-8}))
+        assert (lp_fingerprint(lp, options={"tol": 1e-8})
+                != lp_fingerprint(lp, options={"tol": 1e-6}))
+
+    def test_no_trivial_collisions(self):
+        fps = {lp_fingerprint(_lp(s)) for s in range(50)}
+        assert len(fps) == 50
+
+    def test_compiled_lp_fingerprint(self):
+        from dispatches_tpu import Model
+
+        def build():
+            m = Model("fp-toy")
+            g = m.var("g", 3, lb=0.0)
+            lmp = m.param("lmp", 3)
+            m.add_le(g - np.full(3, 7.0))
+            m.maximize((lmp * g).sum())
+            return m.build()
+
+        p1, p2 = build(), build()
+        lmp = jnp.asarray([1.0, 2.0, 3.0])
+        assert p1.fingerprint() == p2.fingerprint()
+        assert (p1.fingerprint(params={"lmp": lmp})
+                == p2.fingerprint(params={"lmp": lmp}))
+        assert (p1.fingerprint(params={"lmp": lmp})
+                != p1.fingerprint(params={"lmp": lmp + 1.0}))
+        assert p1.fingerprint() != p1.fingerprint(options={"tol": 1e-6})
+
+
+# ---------------------------------------------------------------------
+# tentpole: the continuous-batching slot engine
+# ---------------------------------------------------------------------
+def _engine(bucket, chunk_iters=5, max_iter=40, **kw):
+    kw.setdefault("max_iter", max_iter)
+    seg_cold, seg_resume = dense_segments(
+        LPData(*(0,) * 6), None, False, kw, stop_axis=0
+    )
+    return SlotEngine(
+        "test_serve", LPData, seg_cold, seg_resume, bucket,
+        chunk_iters=chunk_iters, max_iter=kw["max_iter"],
+    ), kw
+
+
+class TestSlotEngine:
+    def test_refill_bitwise_vs_batch(self):
+        # lanes admitted mid-flight into freed slots must come out
+        # bitwise-identical to a one-shot solve_lp_batch at the SAME
+        # bucket size (companion/position independence); the unbatched
+        # solve is NOT the reference on CPU (batched-LAPACK rounding)
+        B = 4
+        eng, kw = _engine(B)
+        lps = {i: _lp(i) for i in range(7)}
+        pending = list(lps)
+        results = {}
+        while pending or eng.active():
+            while pending and eng.free_slots():
+                tok = pending.pop(0)
+                eng.admit(tok, lps[tok])
+            for tok, row, stats in eng.step():
+                results[tok] = row
+        assert sorted(results) == list(range(7))
+        assert eng.refills > 0
+        for tok, lp in lps.items():
+            ref = solve_lp_batch(
+                LPData(*(jnp.stack([a] * B) for a in lp)), **kw
+            )
+            for name, a, b in zip(ref._fields, ref, results[tok]):
+                assert _biteq(np.asarray(a)[0], b), (tok, name)
+
+    def test_evict_returns_best_iterate(self):
+        eng, _ = _engine(2, chunk_iters=2)
+        eng.admit("a", _lp(0))
+        eng.admit("b", _lp(1))
+        eng.step()
+        row = eng.evict("b")
+        assert row is not None
+        assert np.all(np.isfinite(np.asarray(row.x)))
+        assert int(row.iterations) >= 1
+        # an evicted lane's slot is reusable
+        eng.admit("c", _lp(2))
+        assert eng.evict("c") is None  # no chunk ran for c yet
+
+    def test_admit_full_raises(self):
+        eng, _ = _engine(1)
+        eng.admit("a", _lp(0))
+        with pytest.raises(RuntimeError):
+            eng.admit("b", _lp(1))
+
+
+# ---------------------------------------------------------------------
+# queue semantics under a fake clock
+# ---------------------------------------------------------------------
+class TestQueueSemantics:
+    def _svc(self, bucket=2, queue_limit=3, **kw):
+        clock = FakeClock()
+        kw.setdefault("max_iter", 40)
+        svc = make_dense_service(
+            bucket, chunk_iters=kw.pop("chunk_iters", 4),
+            queue_limit=queue_limit, cache_size=kw.pop("cache_size", None),
+            clock=clock, **kw,
+        )
+        return svc, clock
+
+    def test_priority_ordering(self):
+        q = AdmissionQueue(8)
+        reqs = []
+        for i, pri in enumerate([2, 0, 1, 0, 2]):
+            r = SolveRequest(None, priority=pri)
+            r.seq = i
+            reqs.append(r)
+            q.push(r)
+        order = [q.pop().seq for _ in range(len(reqs))]
+        # interactive (0) first in FIFO order, then normal, then batch
+        assert order == [1, 3, 2, 0, 4]
+
+    def test_service_drains_in_priority_order(self):
+        svc, _ = self._svc(bucket=1, queue_limit=8)
+        done_order = []
+        tickets = {}
+        for name, pri in [("b0", "batch"), ("i0", "interactive"),
+                          ("n0", "normal"), ("i1", "interactive")]:
+            tickets[name] = svc.submit(_lp(len(tickets)), priority=pri,
+                                       request_id=name)
+        while any(not t.done() for t in tickets.values()):
+            svc.pump()
+            for name, t in tickets.items():
+                if t.done() and name not in done_order:
+                    done_order.append(name)
+        assert done_order == ["i0", "i1", "n0", "b0"]
+
+    def test_queued_deadline_expiry(self):
+        svc, clock = self._svc()
+        t = svc.submit(_lp(0), timeout=5.0, request_id="late")
+        clock.advance(10.0)
+        svc.pump()
+        res = t.result(timeout=0)
+        assert res.verdict == "deadline_exceeded"
+        assert res.solution is None  # never reached a slot
+
+    def test_inflight_deadline_returns_best_iterate(self):
+        svc, clock = self._svc(chunk_iters=1)
+        t = svc.submit(_lp(0), timeout=5.0, request_id="mid")
+        svc.pump()  # admitted + one chunk (1 iteration), not converged
+        assert not t.done()
+        clock.advance(10.0)
+        svc.pump()  # deadline check evicts with the partial iterate
+        res = t.result(timeout=0)
+        assert res.verdict == "deadline_exceeded"
+        assert res.solution is not None
+        assert np.all(np.isfinite(np.asarray(res.solution.x)))
+
+    def test_backpressure_sheds_lowest_priority_first(self):
+        svc, _ = self._svc(bucket=1, queue_limit=2)
+        low = [svc.submit(_lp(i), priority="batch", request_id=f"b{i}")
+               for i in range(2)]
+        hi = svc.submit(_lp(9), priority="interactive", request_id="hi")
+        # queue was full of batch work: the LAST batch request (worst
+        # sort key) got displaced, the interactive one got in
+        shed = [t for t in low if t.done()]
+        assert len(shed) == 1
+        assert shed[0].request.request_id == "b1"
+        assert shed[0].result(timeout=0).verdict == "shed"
+        assert not hi.done()
+        # an equal-priority newcomer is itself rejected at the door
+        rej = svc.submit(_lp(10), priority="batch", request_id="b2")
+        assert rej.done()
+        assert rej.result(timeout=0).verdict == "shed"
+        svc.drain()
+        assert hi.result(timeout=0).verdict == "healthy"
+
+    def test_cache_hit_bypasses_solver_bitwise(self):
+        svc, _ = self._svc(cache_size=16)
+        t1 = svc.submit(_lp(0), request_id="first")
+        svc.drain()
+        r1 = t1.result(timeout=0)
+        assert r1.ok and not r1.from_cache
+        chunks_before = svc.engine.chunks
+        t2 = svc.submit(_lp(0), request_id="again")
+        assert t2.done()  # resolved synchronously at submit
+        r2 = t2.result(timeout=0)
+        assert r2.from_cache
+        assert svc.engine.chunks == chunks_before  # solver never ran
+        for name, a, b in zip(r1.solution._fields, r1.solution, r2.solution):
+            assert _biteq(a, b), name
+
+    def test_cache_keyed_by_dtype(self):
+        svc, _ = self._svc(cache_size=16)
+        svc.submit(_lp(0))
+        svc.drain()
+        # same values in f32 must MISS (and would need a matching-shape
+        # engine to solve; just check the fingerprints disagree)
+        fp64 = svc._fingerprint(_lp(0), None, None)
+        fp32 = svc._fingerprint(
+            LPData(*(jnp.asarray(np.asarray(a), jnp.float32)
+                     for a in _lp(0))), None, None)
+        assert fp64 != fp32
+
+
+# ---------------------------------------------------------------------
+# service results vs direct batched solves
+# ---------------------------------------------------------------------
+class TestServiceBitwise:
+    def test_results_match_solve_lp_batch_at_bucket(self):
+        B = 4
+        svc, _ = TestQueueSemantics()._svc(bucket=B, queue_limit=16)
+        lps = {f"r{i}": _lp(100 + i) for i in range(6)}
+        tickets = {k: svc.submit(lp, request_id=k) for k, lp in lps.items()}
+        svc.drain()
+        kw = dict(max_iter=40)
+        for k, lp in lps.items():
+            res = tickets[k].result(timeout=0)
+            assert res.verdict == "healthy"
+            ref = solve_lp_batch(
+                LPData(*(jnp.stack([a] * B) for a in lp)), **kw
+            )
+            for name, a, b in zip(ref._fields, ref, res.solution):
+                assert _biteq(np.asarray(a)[0], b), (k, name)
+
+
+# ---------------------------------------------------------------------
+# obs wiring: journal records, verdicts, metrics, trace_summary render
+# ---------------------------------------------------------------------
+class TestServeObs:
+    def test_journal_and_trace_summary(self, tmp_path, capsys):
+        reset_metrics()
+        path = tmp_path / "serve.jsonl"
+        clock = FakeClock()
+        tracer = Tracer(str(path))
+        with use_tracer(tracer):
+            svc = make_dense_service(
+                2, chunk_iters=4, queue_limit=1, cache_size=8,
+                clock=clock, max_iter=40,
+            )
+            t_ok = svc.submit(_lp(0), request_id="ok0")
+            svc.drain()
+            svc.submit(_lp(0), request_id="hit")  # cache hit
+            # queued deadline expiry (queue is empty here, so the request
+            # is queued — not shed — and then expires before admission)
+            late = svc.submit(_lp(3), timeout=1.0, request_id="late")
+            clock.advance(5.0)
+            svc.pump()
+            # shed: fill the 1-slot queue, displace with interactive
+            svc.submit(_lp(1), priority="batch", request_id="victim")
+            svc.submit(_lp(2), priority="interactive", request_id="vip")
+            svc.drain()
+            tracer.close()
+        assert t_ok.result(timeout=0).verdict == "healthy"
+        assert late.result(timeout=0).verdict == "deadline_exceeded"
+
+        recs = read_journal(str(path))
+        solves = [r for r in recs if r.get("kind") == "solve"]
+        assert any(r.get("request_id") == "ok0" for r in solves)
+        sheds = [r for r in recs if r.get("kind") == "event"
+                 and r.get("name") == "serve_shed"]
+        assert sheds and sheds[0]["verdict"] == "shed"
+        deadlines = [r for r in recs if r.get("kind") == "event"
+                     and r.get("name") == "serve_deadline"]
+        assert deadlines and deadlines[0]["verdict"] == "deadline_exceeded"
+        close = next(r for r in recs if r.get("kind") == "close")
+        counters = close["metrics"]["counters"]
+        assert counters.get("serve_shed_total") == 1.0
+        assert counters.get("serve_cache_hit_total") == 1.0
+        assert counters.get(
+            'serve_requests_total{status="deadline_exceeded"}') == 1.0
+
+        ts = importlib.import_module("tools.trace_summary")
+        assert ts.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "req=ok0" in out
+        assert "shed=1" in out
+        assert "deadline_exceeded=1" in out
+        assert "serve latency" in out
+
+    def test_histogram_quantile(self):
+        reg = MetricsRegistry()
+        for v in np.linspace(0.001, 0.99, 200):
+            reg.observe("lat", float(v), buckets=(0.01, 0.1, 0.5, 1.0))
+        assert reg.histogram_quantile("lat", 0.0) is not None
+        p50 = reg.histogram_quantile("lat", 0.5)
+        p95 = reg.histogram_quantile("lat", 0.95)
+        assert 0.3 < p50 < 0.7
+        assert 0.8 < p95 <= 1.0
+        assert reg.histogram_quantile("missing", 0.5) is None
+
+    def test_service_verdicts_severity_known(self):
+        from dispatches_tpu.obs.health import SEVERITY, severity
+
+        assert "deadline_exceeded" in SEVERITY
+        assert "shed" in SEVERITY
+        assert severity("deadline_exceeded") > severity("stalled")
+        assert severity("shed") > severity("deadline_exceeded")
+        assert severity("failed") > severity("shed")
+
+
+# ---------------------------------------------------------------------
+# satellite: trace_summary renders pre-PR-3/4 journals (mixed schema)
+# ---------------------------------------------------------------------
+class TestTraceSummaryMixedSchema:
+    def test_mixed_schema_fixture_renders(self, tmp_path, capsys):
+        recs = [
+            {"kind": "manifest", "schema_version": 1, "run_id": "mixed",
+             "git_sha": "cafe", "platform": "cpu"},
+            # pre-PR-3 solve: iterations as a bare int, no health,
+            # no adaptive_stats
+            {"kind": "solve", "ts": 1.0, "name": "old_style",
+             "stats": {"batch": 8, "converged_frac": 1.0,
+                       "iterations": 17}},
+            # degenerate stats values
+            {"kind": "solve", "ts": 2.0, "name": "odd_stats",
+             "stats": {"batch": None, "converged_frac": "n/a",
+                       "iterations": None}},
+            # a record whose stats explode mid-render must not kill
+            # the remaining lines
+            {"kind": "solve", "ts": 2.5, "name": "hostile",
+             "stats": {"batch": 1, "converged_frac": 1.0,
+                       "iterations": {"min": 1, "max": 2, "median": 1,
+                                      "hist": 42}}},
+            # modern record with health + adaptive stats
+            {"kind": "solve", "ts": 3.0, "name": "new_style",
+             "stats": {"batch": 4, "converged_frac": 1.0,
+                       "iterations": {"min": 3, "max": 9, "median": 5.0}},
+             "adaptive_stats": {"lanes_retired": 4, "buckets": [4],
+                                "compile_hits": 1, "compile_misses": 1},
+             "health": {"counts": {"healthy": 4}, "n_bad": 0,
+                        "worst": {"lane": 0, "verdict": "healthy"}}},
+            {"kind": "close", "ts": 4.0, "retrace_totals": {}},
+        ]
+        path = tmp_path / "mixed.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        ts = importlib.import_module("tools.trace_summary")
+        assert ts.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "old_style: batch=8" in out
+        assert "iters[17..17 med 17]" in out
+        assert "odd_stats" in out
+        assert "unrenderable solve record" in out  # hostile degraded, not fatal
+        assert "new_style" in out and "verdict=healthy" in out
+
+    def test_journal_diff_goodput_direction(self):
+        jd = importlib.import_module("tools.journal_diff")
+        assert not jd.lower_is_better("serve/loadgen/goodput_rps")
+        assert jd.lower_is_better("serve/loadgen/p95_s")

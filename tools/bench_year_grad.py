@@ -26,7 +26,7 @@ sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "YEAR_GRAD.json")
 
 
-from _watchdog import with_watchdog  # noqa: E402  (tools/ is sys.path[0])
+from dispatches_tpu.obs.watchdog import with_watchdog  # noqa: E402
 
 
 def main():

@@ -56,7 +56,7 @@ DEFAULT_THRESHOLD = 0.10
 _HIGHER_IS_BETTER = (
     "per_sec", "per_chip", "converged", "mfu", "tflops", "utilization",
     "throughput", 'verdict="healthy"', "iters_saved", "cache_hit",
-    "lanes_retired",
+    "lanes_retired", "goodput",
 )
 
 
@@ -421,6 +421,37 @@ def self_check(out=sys.stdout) -> int:
          {**abase,
           'metric/compile_cache_hit_total{entry="solve_lp_banded"}': 2.0},
          True)
+
+    # serve-layer metrics (dispatches_tpu/serve + tools/loadgen.py):
+    # latency percentiles and shed/deadline counts are lower-is-better,
+    # goodput higher-is-better; service verdicts ride the same
+    # solve_verdict_total machinery as solver health
+    sbase = {
+        "serve/loadgen/p95_s": 0.040,
+        "serve/loadgen/goodput_rps": 120.0,
+        'metric/serve_shed_total': 0.0,
+        'metric/solve_verdict_total{solve="serve",verdict="healthy"}': 200.0,
+    }
+
+    def srun(name: str, new: Dict[str, float], expect: bool, **kw) -> None:
+        rows = compare(sbase, new, **kw)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    srun("identical serve metrics pass", dict(sbase), False)
+    srun("p95 latency regression >10% fails (lower is better)",
+         {**sbase, "serve/loadgen/p95_s": 0.060}, True)
+    srun("p95 latency improving passes",
+         {**sbase, "serve/loadgen/p95_s": 0.020}, False)
+    srun("goodput dropping >10% fails (higher is better)",
+         {**sbase, "serve/loadgen/goodput_rps": 80.0}, True)
+    srun("goodput growing passes",
+         {**sbase, "serve/loadgen/goodput_rps": 200.0}, False)
+    srun("load shedding appearing from zero fails",
+         {**sbase, "metric/serve_shed_total": 5.0}, True)
+    srun("deadline_exceeded verdict appearing fails",
+         {**sbase,
+          'metric/solve_verdict_total{solve="serve",verdict="deadline_exceeded"}':
+          3.0}, True)
 
     ok = True
     for name, want, got in checks:

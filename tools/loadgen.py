@@ -1,0 +1,341 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the dispatch service.
+
+Drives `dispatches_tpu.serve.DispatchService` with Poisson arrivals
+(seeded, open-loop: arrival times are fixed up front and never wait for
+completions — queueing delay is part of the measurement) and reports
+latency percentiles and goodput. The same arrival schedule can be
+replayed against a serial one-solve-at-a-time baseline to quantify the
+continuous-batching win.
+
+    python tools/loadgen.py --requests 400 --rate 200 --bucket 8
+    python tools/loadgen.py --baseline serial --requests 400 --rate 200
+    python tools/loadgen.py --self-check          # CI smoke (CPU)
+
+`--self-check` pushes ~200 small LPs through the service, asserts every
+ticket resolves (zero lost requests) and every non-cached solve
+converges, and gates the measured p95 against a generous CPU bound via
+the `journal_diff` comparison machinery (so the gate's direction and
+threshold semantics match the rest of CI). Exit 0 pass / 1 gate trip /
+2 error.
+
+The workload is synthetic: small random feasible box LPs with a
+configurable duplicate fraction (`--dup-frac`) so the fingerprint cache
+sees realistic repeats. Problems share shapes by construction — one
+service bucket serves them all.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+RC_OK, RC_GATE, RC_ERROR = 0, 1, 2
+
+
+def _enable_x64() -> None:
+    # repo-wide tools convention: f64 on CPU — tol=1e-8 solves are not
+    # reliably reachable in f32 (borderline lanes stall, see docs)
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+def make_problem(seed: int, n: int = 8, m: int = 4):
+    """One small feasible box LP (A x = b with x0 interior, bounded).
+
+    HOST-resident numpy arrays on purpose: a solve request arrives from
+    outside the device (a market feed, an RPC payload), so both the
+    service and the serial baseline pay the host->device transfer as part
+    of serving it. The service amortizes that I/O across its bucket —
+    which is part of the continuous-batching win being measured — while
+    the serial loop pays it per request."""
+    import numpy as np
+
+    from dispatches_tpu.core.program import LPData
+
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    b = A @ x0
+    c = r.normal(size=n)
+    return LPData(A, b, c, np.zeros(n), np.full(n, 4.0), np.float64(0.0))
+
+
+def arrival_schedule(n: int, rate: float, seed: int):
+    """Poisson process: exponential inter-arrival gaps at `rate` req/s."""
+    import numpy as np
+
+    gaps = np.random.default_rng(seed).exponential(1.0 / rate, size=n)
+    return np.cumsum(gaps)
+
+
+def problem_seeds(n: int, dup_frac: float, seed: int):
+    """Request -> problem-seed map with ~dup_frac exact repeats."""
+    import numpy as np
+
+    r = np.random.default_rng(seed + 1)
+    uniques = max(1, int(round(n * (1.0 - dup_frac))))
+    pool = np.arange(uniques)
+    extra = r.choice(pool, size=n - uniques) if n > uniques else []
+    seeds = np.concatenate([pool, np.asarray(extra, dtype=pool.dtype)])
+    r.shuffle(seeds)
+    return [int(s) for s in seeds]
+
+
+def _percentiles(latencies):
+    import numpy as np
+
+    if not latencies:
+        return {"p50_s": None, "p95_s": None, "p99_s": None}
+    q = np.percentile(np.asarray(latencies), [50, 95, 99])
+    return {"p50_s": float(q[0]), "p95_s": float(q[1]), "p99_s": float(q[2])}
+
+
+def run_service(
+    requests: int = 200,
+    rate: float = 200.0,
+    bucket: int = 8,
+    chunk_iters: int = 8,
+    max_iter: int = 60,
+    queue_limit: int = 256,
+    dup_frac: float = 0.25,
+    seed: int = 0,
+    deadline_s: float = None,
+    lp_n: int = 8,
+    lp_m: int = 4,
+) -> dict:
+    """Drive the service at `rate` req/s; returns the report dict."""
+    _enable_x64()
+    from dispatches_tpu.serve import make_dense_service
+
+    svc = make_dense_service(
+        bucket, chunk_iters=chunk_iters, max_iter=max_iter,
+        queue_limit=queue_limit,
+    )
+    seeds = problem_seeds(requests, dup_frac, seed)
+    problems = {s: make_problem(s, n=lp_n, m=lp_m) for s in set(seeds)}
+    # warm the executables outside the measurement window (a model server
+    # would have done this at deploy time)
+    svc.submit(make_problem(10**6, n=lp_n, m=lp_m))
+    svc.drain()
+    sched = arrival_schedule(requests, rate, seed)
+
+    svc.start()
+    t0 = time.monotonic()
+    tickets = []
+    try:
+        for i, (s, due) in enumerate(zip(seeds, sched)):
+            lag = t0 + due - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            tickets.append(svc.submit(
+                problems[s], request_id=f"r{i}",
+                timeout=deadline_s,
+            ))
+        results = [t.result(timeout=120.0) for t in tickets]
+    finally:
+        svc.stop()
+    wall = time.monotonic() - t0
+
+    ok = [r for r in results if r.ok]
+    lat = [r.latency for r in results if r.latency is not None]
+    report = {
+        "mode": "service",
+        "requests": requests,
+        "rate_rps": rate,
+        "bucket": bucket,
+        "resolved": len(results),
+        "lost": requests - len(results),
+        "ok": len(ok),
+        "cached": sum(r.from_cache for r in results),
+        "shed": sum(r.verdict == "shed" for r in results),
+        "deadline_exceeded": sum(
+            r.verdict == "deadline_exceeded" for r in results
+        ),
+        "unhealthy": sum(
+            r.verdict not in ("healthy", "slow", "shed", "deadline_exceeded")
+            for r in results
+        ),
+        "wall_s": wall,
+        "goodput_rps": len(ok) / wall if wall > 0 else 0.0,
+        **_percentiles(lat),
+        "service": svc.stats(),
+    }
+    return report
+
+
+def run_serial(
+    requests: int = 200,
+    rate: float = 200.0,
+    max_iter: int = 60,
+    dup_frac: float = 0.25,
+    seed: int = 0,
+    lp_n: int = 8,
+    lp_m: int = 4,
+) -> dict:
+    """Naive baseline: the same open-loop arrival schedule served by one
+    jitted unbatched solve at a time, FIFO, no cache. Latency counts the
+    queueing delay a late-arriving request suffers behind earlier ones —
+    exactly what continuous batching is supposed to crush. Each request
+    is served end-to-end: host payload in, host response (objective,
+    primal vector, converged flag) out — the same contract the service's
+    harvest delivers."""
+    _enable_x64()
+    import numpy as np
+    import jax
+
+    from dispatches_tpu.solvers.ipm import solve_lp
+
+    seeds = problem_seeds(requests, dup_frac, seed)
+    problems = {s: make_problem(s, n=lp_n, m=lp_m) for s in set(seeds)}
+    solve = jax.jit(lambda lp: solve_lp(lp, max_iter=max_iter))
+    jax.block_until_ready(solve(next(iter(problems.values()))))  # warm
+
+    sched = arrival_schedule(requests, rate, seed)
+    t0 = time.monotonic()
+    lat, ok = [], 0
+    for s, due in zip(seeds, sched):
+        lag = t0 + due - time.monotonic()
+        if lag > 0:
+            time.sleep(lag)
+        sol = solve(problems[s])
+        resp = (float(sol.obj), np.asarray(sol.x), bool(sol.converged))
+        lat.append(time.monotonic() - (t0 + due))
+        ok += resp[2]
+    wall = time.monotonic() - t0
+    return {
+        "mode": "serial",
+        "requests": requests,
+        "rate_rps": rate,
+        "resolved": requests,
+        "lost": 0,
+        "ok": ok,
+        "wall_s": wall,
+        "goodput_rps": ok / wall if wall > 0 else 0.0,
+        **_percentiles(lat),
+    }
+
+
+def self_check(out=sys.stdout) -> int:
+    """CI smoke: ~200 requests on CPU, zero lost, p95 gated."""
+    from dispatches_tpu.obs.journal import Tracer, use_tracer
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import journal_diff
+
+    journal = os.path.join(
+        os.environ.get("LOADGEN_OUT", "/tmp"), "loadgen_selfcheck.jsonl"
+    )
+    if os.path.exists(journal):
+        os.unlink(journal)  # Tracer appends; the gate wants one fresh run
+    with use_tracer(
+        Tracer(journal, manifest_extra={"run": "loadgen-self-check"})
+    ) as tr:
+        report = run_service(
+            requests=200, rate=400.0, bucket=8, dup_frac=0.25, seed=0,
+        )
+        tr.event("loadgen_report", **{
+            k: v for k, v in report.items() if isinstance(v, (int, float))
+        })
+        tr.close()
+
+    print(json.dumps(report, indent=2, default=str), file=out)
+    failures = []
+    if report["lost"]:
+        failures.append(f"{report['lost']} lost requests")
+    if report["shed"] or report["deadline_exceeded"]:
+        failures.append(
+            "unexpected shed/deadline in an unbounded-queue run: "
+            f"{report['shed']}/{report['deadline_exceeded']}"
+        )
+    if report["unhealthy"]:
+        failures.append(f"{report['unhealthy']} unhealthy solves")
+    if report["ok"] + report["cached"] < report["requests"]:
+        # cached results are also ok; this catches double-counting drift
+        failures.append("ok+cached below request count")
+
+    # p95 gate through journal_diff.compare: same direction/threshold
+    # semantics as the CI journal gates. The bound is deliberately loose —
+    # shared CI boxes jitter; the gate catches order-of-magnitude
+    # regressions (e.g. losing continuous batching), not milliseconds.
+    bound = {"serve/loadgen/p95_s": float(
+        os.environ.get("LOADGEN_P95_BOUND_S", "2.0")
+    )}
+    measured = {"serve/loadgen/p95_s": report["p95_s"]}
+    rows = journal_diff.compare(bound, measured, default_threshold=0.0)
+    for r in rows:
+        if r["regression"]:
+            failures.append(
+                f"p95 gate: {r['metric']} = {r['new']:.4f}s "
+                f"over bound {r['base']:.4f}s"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"loadgen self-check FAIL: {f}", file=out)
+        return RC_GATE
+    print(
+        f"loadgen self-check passed: {report['requests']} requests, "
+        f"0 lost, p95={report['p95_s'] * 1e3:.1f}ms "
+        f"goodput={report['goodput_rps']:.0f}/s "
+        f"(journal: {journal})",
+        file=out,
+    )
+    return RC_OK
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="loadgen",
+        description="Poisson open-loop load generator for the dispatch "
+        "service (or a serial baseline).",
+    )
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="mean arrival rate, requests/second")
+    ap.add_argument("--bucket", type=int, default=8)
+    ap.add_argument("--chunk-iters", type=int, default=8)
+    ap.add_argument("--max-iter", type=int, default=60)
+    ap.add_argument("--queue-limit", type=int, default=256)
+    ap.add_argument("--dup-frac", type=float, default=0.25,
+                    help="fraction of requests repeating an earlier problem")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request deadline, seconds from submit")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--baseline", choices=["serial"], default=None,
+                    help="run the one-at-a-time baseline instead")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report dict only")
+    ap.add_argument("--self-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+
+    if args.baseline == "serial":
+        report = run_serial(
+            requests=args.requests, rate=args.rate, max_iter=args.max_iter,
+            dup_frac=args.dup_frac, seed=args.seed,
+        )
+    else:
+        report = run_service(
+            requests=args.requests, rate=args.rate, bucket=args.bucket,
+            chunk_iters=args.chunk_iters, max_iter=args.max_iter,
+            queue_limit=args.queue_limit, dup_frac=args.dup_frac,
+            seed=args.seed, deadline_s=args.deadline,
+        )
+    print(json.dumps(report, indent=None if args.json else 2, default=str))
+    return RC_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,10 +17,12 @@ import time
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "MATMUL_PEAK.json")
 
 
-from _watchdog import with_watchdog  # noqa: E402  (tools/ is sys.path[0])
+from dispatches_tpu.obs.watchdog import with_watchdog  # noqa: E402
 
 
 def main():

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""JSONL front door for the in-process dispatch service.
+
+Reads one JSON request per line from stdin (or --input FILE), writes one
+JSON response per line to stdout as completions land — responses are
+asynchronous and carry the request ``id``, so they may interleave out of
+submission order under load (that is the point of the service).
+
+Request lines:
+
+    {"op": "solve", "id": "r1",
+     "problem": {"A": [[...]], "b": [...], "c": [...],
+                 "l": [...], "u": [...], "c0": 0.0},
+     "priority": "interactive" | "normal" | "batch",   # default normal
+     "timeout": 0.5}                                    # optional, seconds
+    {"op": "stats"}        # service counters + latency percentiles
+    {"op": "drain"}        # block until queue and slots are empty
+
+Responses:
+
+    {"id": "r1", "verdict": "healthy", "objective": ..., "x": [...],
+     "iterations": 17, "latency_s": 0.012, "from_cache": false}
+
+The service (bucket size, solver options) is built from the CLI flags at
+the FIRST solve request, using that problem's shapes; every later
+problem must match them. Unknown ops and malformed lines produce an
+``{"error": ...}`` response instead of killing the loop.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _parse_problem(spec: dict):
+    import jax.numpy as jnp
+
+    from dispatches_tpu.core.program import LPData
+
+    try:
+        return LPData(
+            jnp.asarray(spec["A"], float), jnp.asarray(spec["b"], float),
+            jnp.asarray(spec["c"], float), jnp.asarray(spec["l"], float),
+            jnp.asarray(spec["u"], float),
+            jnp.asarray(spec.get("c0", 0.0), float),
+        )
+    except KeyError as e:
+        raise ValueError(f"problem spec missing field {e}") from None
+
+
+def _response(result) -> dict:
+    out = {
+        "id": result.request_id,
+        "verdict": result.verdict,
+        "from_cache": bool(result.from_cache),
+        "latency_s": result.latency,
+        "iterations": result.iterations,
+    }
+    sol = result.solution
+    if sol is not None:
+        out["objective"] = float(sol.obj)
+        out["x"] = [float(v) for v in sol.x]
+        out["converged"] = bool(sol.converged)
+    return out
+
+
+class _Reaper:
+    """Prints ticket results as they resolve, preserving one-line-per-
+    response framing under concurrent completions."""
+
+    def __init__(self, out):
+        self._out = out
+        self._lock = threading.Lock()
+        self._pending = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def watch(self, ticket) -> None:
+        with self._lock:
+            self._pending.append(ticket)
+
+    def emit(self, obj: dict) -> None:
+        with self._lock:
+            print(json.dumps(obj, default=str), file=self._out, flush=True)
+
+    def _run(self) -> None:
+        while not self._stop.wait(0.002):
+            self._sweep()
+
+    def _sweep(self) -> None:
+        with self._lock:
+            done = [t for t in self._pending if t.done()]
+            self._pending = [t for t in self._pending if not t.done()]
+        for t in done:
+            self.emit(_response(t.result(0)))
+
+    def close(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending:
+                    break
+            self._sweep()
+        self._stop.set()
+        self._thread.join()
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve_dispatch",
+        description="stdin/JSONL request loop over the dispatch service.",
+    )
+    ap.add_argument("--input", default="-", help="request file (default stdin)")
+    ap.add_argument("--bucket", type=int, default=8)
+    ap.add_argument("--chunk-iters", type=int, default=8)
+    ap.add_argument("--max-iter", type=int, default=60)
+    ap.add_argument("--queue-limit", type=int, default=256)
+    ap.add_argument("--cache-size", type=int, default=256)
+    ap.add_argument("--journal", default=None,
+                    help="write a JSONL run journal here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # tools convention: f64 on CPU
+
+    from dispatches_tpu.obs.journal import Tracer, set_tracer
+    from dispatches_tpu.serve import make_dense_service
+
+    tracer = None
+    if args.journal:
+        tracer = Tracer(args.journal, manifest_extra={"run": "serve_dispatch"})
+        set_tracer(tracer)
+
+    svc = None
+    reaper = _Reaper(out)
+    fh = sys.stdin if args.input == "-" else open(args.input, "r")
+    try:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                op = req.get("op", "solve")
+                if op == "solve":
+                    lp = _parse_problem(req["problem"])
+                    if svc is None:
+                        svc = make_dense_service(
+                            args.bucket, chunk_iters=args.chunk_iters,
+                            max_iter=args.max_iter,
+                            queue_limit=args.queue_limit,
+                            cache_size=args.cache_size or None,
+                        )
+                        svc.start()
+                    reaper.watch(svc.submit(
+                        lp,
+                        priority=req.get("priority", "normal"),
+                        timeout=req.get("timeout"),
+                        request_id=req.get("id"),
+                    ))
+                elif op == "stats":
+                    reaper.emit(
+                        {"stats": svc.stats() if svc else {"idle": True}}
+                    )
+                elif op == "drain":
+                    if svc is not None:
+                        svc.stop(drain=True)
+                        svc.start()
+                    reaper.emit({"drained": True})
+                else:
+                    reaper.emit({"error": f"unknown op {op!r}"})
+            except Exception as e:
+                reaper.emit({"error": f"{type(e).__name__}: {e}"})
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+        if svc is not None:
+            svc.stop(drain=True)
+        reaper.close()
+        if tracer is not None:
+            set_tracer(None)
+            tracer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,8 +76,9 @@ def _split_runs(events: List[dict]) -> List[List[dict]]:
 # verdict badness order, mirrored from obs.health.SEVERITY (kept local so
 # summarizing a journal never needs to import jax-adjacent packages)
 _SEVERITY = (
-    "healthy", "slow", "cycling", "stalled", "diverged", "nonfinite",
-    "hang", "failed",
+    "healthy", "slow", "cycling", "stalled",
+    "deadline_exceeded", "shed",
+    "diverged", "nonfinite", "hang", "failed",
 )
 
 
@@ -165,63 +166,82 @@ def _print_solves(run: List[dict], out) -> None:
     print("  solves:", file=out)
     for ev in solves:
         name = ev.get("name", "?")
-        stats = ev.get("stats")
-        if not isinstance(stats, dict):
-            err = ev.get("stats_error", "no stats")
-            print(f"    {name}: ({err})", file=out)
-            continue
-        it = stats.get("iterations", {})
-        line = (
-            f"    {name}: batch={stats.get('batch')} "
-            f"converged={stats.get('converged_frac', float('nan')):.3f} "
-            f"iters[{it.get('min')}..{it.get('max')} "
-            f"med {it.get('median')}]"
+        try:
+            _print_one_solve(name, ev, out)
+        except Exception as e:  # a malformed record never kills the render
+            print(f"    {name}: (unrenderable solve record: "
+                  f"{type(e).__name__}: {e})", file=out)
+
+
+def _print_one_solve(name: str, ev: dict, out) -> None:
+    stats = ev.get("stats")
+    if not isinstance(stats, dict):
+        err = ev.get("stats_error", "no stats")
+        print(f"    {name}: ({err})", file=out)
+        return
+    # pre-PR-3 journals carried iterations as a bare number (or a list),
+    # not the {min,max,median,hist} dict later schemas write
+    it = stats.get("iterations", {})
+    if not isinstance(it, dict):
+        it = {"min": it, "max": it, "median": it}
+    conv = stats.get("converged_frac", float("nan"))
+    conv = conv if isinstance(conv, (int, float)) else float("nan")
+    line = (
+        f"    {name}: batch={stats.get('batch')} "
+        f"converged={conv:.3f} "
+        f"iters[{it.get('min')}..{it.get('max')} "
+        f"med {it.get('median')}]"
+    )
+    if stats.get("nonfinite_count"):
+        line += f" nonfinite={stats['nonfinite_count']}"
+    # adaptive-batching columns (runtime/adaptive.py): the sweep
+    # runners attach these as solve-event attrs
+    if ev.get("warm_starts"):
+        line += " warm"
+    ad = ev.get("adaptive_stats")
+    if isinstance(ad, dict):
+        line += (
+            f" adaptive[retired={ad.get('lanes_retired')}"
+            f" buckets={ad.get('buckets')}"
+            f" compile {ad.get('compile_hits')}h/"
+            f"{ad.get('compile_misses')}m]"
         )
-        if stats.get("nonfinite_count"):
-            line += f" nonfinite={stats['nonfinite_count']}"
-        # adaptive-batching columns (runtime/adaptive.py): the sweep
-        # runners attach these as solve-event attrs
-        if ev.get("warm_starts"):
-            line += " warm"
-        ad = ev.get("adaptive_stats")
-        if isinstance(ad, dict):
-            line += (
-                f" adaptive[retired={ad.get('lanes_retired')}"
-                f" buckets={ad.get('buckets')}"
-                f" compile {ad.get('compile_hits')}h/"
-                f"{ad.get('compile_misses')}m]"
-            )
-        elif ev.get("adaptive"):
-            line += " adaptive"
-        health = ev.get("health")
-        if isinstance(health, dict):
-            line += _fmt_verdict(health)
-        print(line, file=out)
-        if it.get("hist"):
-            print(f"      hist: {_fmt_hist(it['hist'])}", file=out)
-        tr = ev.get("trace")
-        if isinstance(tr, dict):
-            rec = tr.get("recorded_iterations", [])
-            nd = tr.get("n_divergent", 0)
-            flag = f"  DIVERGENT x{nd}" if nd else ""
-            rng = f"{min(rec)}..{max(rec)}" if rec else "none"
-            print(f"      trace: recorded iters {rng}{flag}", file=out)
-        cost = ev.get("cost")
-        if isinstance(cost, dict):
-            parts = []
-            if isinstance(cost.get("flops"), (int, float)):
-                parts.append(f"flops={cost['flops']:.3g}")
-            if isinstance(cost.get("bytes_accessed"), (int, float)):
-                parts.append(f"bytes={cost['bytes_accessed']:.3g}")
-            if isinstance(cost.get("peak_bytes"), (int, float)):
-                parts.append(f"peak_mem={cost['peak_bytes'] / 2**20:.0f}MiB")
-            rl = cost.get("roofline")
-            if isinstance(rl, dict) and isinstance(
-                rl.get("utilization"), (int, float)
-            ):
-                parts.append(f"roofline={rl['utilization']:.2%}")
-            if parts:
-                print(f"      cost: {' '.join(parts)}", file=out)
+    elif ev.get("adaptive"):
+        line += " adaptive"
+    # serve-layer columns (dispatches_tpu/serve): per-request solves
+    if ev.get("request_id") is not None:
+        line += f" req={ev['request_id']}"
+    if isinstance(ev.get("latency_s"), (int, float)):
+        line += f" latency={ev['latency_s'] * 1e3:.1f}ms"
+    health = ev.get("health")
+    if isinstance(health, dict):
+        line += _fmt_verdict(health)
+    print(line, file=out)
+    if it.get("hist"):
+        print(f"      hist: {_fmt_hist(it['hist'])}", file=out)
+    tr = ev.get("trace")
+    if isinstance(tr, dict):
+        rec = tr.get("recorded_iterations", [])
+        nd = tr.get("n_divergent", 0)
+        flag = f"  DIVERGENT x{nd}" if nd else ""
+        rng = f"{min(rec)}..{max(rec)}" if rec else "none"
+        print(f"      trace: recorded iters {rng}{flag}", file=out)
+    cost = ev.get("cost")
+    if isinstance(cost, dict):
+        parts = []
+        if isinstance(cost.get("flops"), (int, float)):
+            parts.append(f"flops={cost['flops']:.3g}")
+        if isinstance(cost.get("bytes_accessed"), (int, float)):
+            parts.append(f"bytes={cost['bytes_accessed']:.3g}")
+        if isinstance(cost.get("peak_bytes"), (int, float)):
+            parts.append(f"peak_mem={cost['peak_bytes'] / 2**20:.0f}MiB")
+        rl = cost.get("roofline")
+        if isinstance(rl, dict) and isinstance(
+            rl.get("utilization"), (int, float)
+        ):
+            parts.append(f"roofline={rl['utilization']:.2%}")
+        if parts:
+            print(f"      cost: {' '.join(parts)}", file=out)
 
 
 def _print_health_footer(run: List[dict], out) -> None:
@@ -274,6 +294,50 @@ def _print_health_footer(run: List[dict], out) -> None:
         print(f"  worst offender: {where} ({', '.join(bits)})", file=out)
 
 
+def _snapshot_quantile(hist: dict, q: float):
+    """Approximate q-quantile from a close-record histogram snapshot
+    ({"count", "sum", "buckets": {bound_str: count}}); None when empty
+    or malformed (old journals carry no histograms at all)."""
+    try:
+        total = hist["count"]
+        if not total:
+            return None
+        rank = q * total
+        cum = 0.0
+        prev_bound = 0.0
+        for bound_str, n in hist["buckets"].items():
+            prev = cum
+            cum += n
+            if cum >= rank:
+                if bound_str == "+Inf":
+                    return prev_bound
+                b = float(bound_str)
+                frac = (rank - prev) / n if n else 0.0
+                return prev_bound + (b - prev_bound) * frac
+            if bound_str != "+Inf":
+                prev_bound = float(bound_str)
+        return prev_bound
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _print_serve_latency(histograms: dict, out) -> None:
+    """One line per serve_latency_seconds{...} series: count + p50/p95."""
+    for series in sorted(histograms):
+        if not series.startswith("serve_latency_seconds"):
+            continue
+        h = histograms[series]
+        p50 = _snapshot_quantile(h, 0.5)
+        p95 = _snapshot_quantile(h, 0.95)
+        if p50 is None or p95 is None:
+            continue
+        print(
+            f"  serve latency {series[len('serve_latency_seconds'):] or '{}'}:"
+            f" n={h.get('count')} p50~{p50 * 1e3:.1f}ms p95~{p95 * 1e3:.1f}ms",
+            file=out,
+        )
+
+
 def _print_run(run: List[dict], out, max_spans: int) -> None:
     man = next((e for e in run if e.get("kind") == "manifest"), {})
     sha = (man.get("git_sha") or "?")[:12]
@@ -301,6 +365,9 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
                 f"{k}={v:g}" for k, v in sorted(counters.items())
             )
             print(f"  metrics: {txt}", file=out)
+        _print_serve_latency(
+            (close.get("metrics") or {}).get("histograms") or {}, out
+        )
     else:
         # no close record — the run died; sum span deltas as best effort
         totals: dict = {}
