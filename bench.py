@@ -438,6 +438,11 @@ def _year_batch_child(npz_path, By):
         "iterations": [int(v) for v in np.asarray(sol2.iterations)],
         "scales_used": [float(v) for v in scales2],
     }
+    # the parent set DISPATCHES_TPU_TRACEPARENT before spawning us; echo
+    # it so the result row carries its cross-process trace lineage
+    tp = os.environ.get("DISPATCHES_TPU_TRACEPARENT")
+    if tp:
+        out["traceparent"] = tp
     if _COST:
         try:
             from dispatches_tpu.obs import cost as obs_cost
@@ -485,6 +490,14 @@ def _run_year_batch_via_child(ylmp, ycf, By0, scales=None):
         # stale result; it must not be returned as this run's measurement
         os.remove(out_path)
     np.savez(npz_path, ylmp=ylmp, ycf=ycf, scales=scales)
+    # cross-process trace lineage (obs.reqtrace): hand the child a
+    # traceparent via env so its journal manifest — and its result row —
+    # parent onto this bench run's trace instead of starting a fresh one
+    from dispatches_tpu.obs.reqtrace import TRACEPARENT_ENV, TraceContext
+
+    ctx = TraceContext.from_environ() or TraceContext.new()
+    child_env = dict(os.environ)
+    child_env[TRACEPARENT_ENV] = ctx.child().to_traceparent()
     errors = []
     By = By0
     retried_this_By = False
@@ -499,6 +512,7 @@ def _run_year_batch_via_child(ylmp, ycf, By0, scales=None):
                     [sys.executable, os.path.abspath(__file__),
                      "--year-batch-child", npz_path, str(By)],
                     cwd=REPO,
+                    env=child_env,
                     timeout=1500.0,
                     capture_output=True,
                     text=True,

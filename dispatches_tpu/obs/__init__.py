@@ -23,6 +23,11 @@ Three pillars (see docs/observability.md):
    an opt-in flight recorder that snapshots failing problem instances
    into a capped ring buffer for `tools/replay_solve.py`, and a shared
    hang guard that journals stuck device calls as a `hang` verdict.
+8. **Request journeys & SLOs** (`obs.reqtrace`, `obs.slo`): per-request
+   phase attribution for the serving tier (admit / queue_wait /
+   slot_admit / chunk compute / harvest / respond) with W3C-style trace
+   contexts that survive process hops, schema-v3 ``journey`` journal
+   records, and multi-window SLO burn-rate evaluation over them.
 """
 from .cost import (  # noqa: F401
     chip_peak_tflops,
@@ -59,6 +64,7 @@ from .memory import device_memory_stats, memory_watermark_bytes  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
     counter_delta,
+    describe,
     get_registry,
     inc,
     observe,
@@ -80,6 +86,13 @@ from .recorder import (  # noqa: F401
     maybe_capture,
     set_recorder,
 )
+from .reqtrace import (  # noqa: F401
+    TRACEPARENT_ENV,
+    EngineJourneyObserver,
+    Journey,
+    TraceContext,
+    start_journey,
+)
 from .retrace import (  # noqa: F401
     note_trace,
     reset_retrace_counts,
@@ -87,6 +100,13 @@ from .retrace import (  # noqa: F401
     retrace_delta,
     signature_of,
     total_retraces,
+)
+from .slo import (  # noqa: F401
+    SLO,
+    breaches,
+    burn_rates,
+    evaluate_slos,
+    worst_burn_rate,
 )
 from .trace import (  # noqa: F401
     SolveTrace,
@@ -158,4 +178,15 @@ __all__ = [
     "load_capture",
     "WatchdogTimeout",
     "with_watchdog",
+    "describe",
+    "TraceContext",
+    "Journey",
+    "EngineJourneyObserver",
+    "start_journey",
+    "TRACEPARENT_ENV",
+    "SLO",
+    "burn_rates",
+    "evaluate_slos",
+    "worst_burn_rate",
+    "breaches",
 ]

@@ -47,7 +47,10 @@ from . import retrace as _retrace
 # v2: manifest gains "schema_version" + "clock"; span_start/span_end carry
 #     monotonic "mono" stamps; span_end gains "metrics" (counter deltas);
 #     solve records gain optional "cost"; close gains "metrics" snapshot.
-_SCHEMA_VERSION = 2
+# v3: "journey" records (obs.reqtrace): per-request phase timings with
+#     W3C-style trace ids; manifest gains optional "trace_id" /
+#     "parent_span_id" lineage parsed from DISPATCHES_TPU_TRACEPARENT.
+_SCHEMA_VERSION = 3
 
 
 def _git_sha() -> Optional[str]:
@@ -140,6 +143,18 @@ def build_manifest(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "precision": _precision_env(),
     }
     m.update(_device_info())
+    try:
+        # a parent process (bench.py child legs, serve_dispatch callers)
+        # hands its trace identity down via the environment; recording it
+        # in the manifest parents this whole journal onto the caller span
+        from .reqtrace import TraceContext
+
+        ctx = TraceContext.from_environ()
+        if ctx is not None:
+            m["trace_id"] = ctx.trace_id
+            m["parent_span_id"] = ctx.span_id
+    except Exception:
+        pass
     if extra:
         m.update(extra)
     return m
@@ -299,6 +314,12 @@ class Tracer:
             rec["health_error"] = f"{type(e).__name__}: {e}"
         self._emit(rec)
 
+    def journey(self, **fields: Any) -> None:
+        """Record a finished request journey (schema v3; see
+        `obs.reqtrace`): trace ids, terminal, phase durations, chunk
+        segments. Emitted by `reqtrace.Journey.finish`, one per request."""
+        self._emit({"kind": "journey", "ts": time.time(), **fields})
+
     def close(self) -> None:
         """Emit a final record with cumulative retrace counts and the full
         metrics-registry snapshot, then close the file. Idempotent."""
@@ -346,6 +367,9 @@ class NullTracer:
     def solve_event(
         self, name: str, sol: Any, trace: Any = None, cost: Any = None, **attrs: Any
     ) -> None:
+        pass
+
+    def journey(self, **fields: Any) -> None:
         pass
 
     def close(self) -> None:

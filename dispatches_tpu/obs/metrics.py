@@ -41,12 +41,24 @@ def _series_key(name: str, labels: Mapping[str, Any]) -> _SeriesKey:
     return (str(name), tuple(sorted((str(k), str(v)) for k, v in labels.items())))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition-format label-value escaping: backslash,
+    double quote, and newline must be escaped inside ``k="v"``."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """# HELP text escaping: only backslash and newline."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def series_name(name: str, labels: Mapping[str, Any]) -> str:
     """Prometheus-style series string, ``name{k="v",...}`` (bare ``name``
-    when unlabeled) — the snapshot/journal key format."""
+    when unlabeled) — the snapshot/journal key format. Label values are
+    escaped per the exposition format (``\\``, ``"``, newline)."""
     if not labels:
         return str(name)
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in sorted(
         (str(k), str(v)) for k, v in labels.items()
     ))
     return f"{name}{{{inner}}}"
@@ -84,6 +96,7 @@ class MetricsRegistry:
         self._counters: Dict[_SeriesKey, float] = {}
         self._gauges: Dict[_SeriesKey, float] = {}
         self._hists: Dict[_SeriesKey, _Histogram] = {}
+        self._help: Dict[str, str] = {}  # metric base name -> HELP text
 
     # -- mutators ------------------------------------------------------
     def inc(self, name: str, value: float = 1.0, **labels: Any) -> None:
@@ -114,7 +127,16 @@ class MetricsRegistry:
                 h = self._hists[key] = _Histogram(buckets or DEFAULT_BUCKETS)
             h.observe(float(value))
 
+    def describe(self, name: str, text: str) -> None:
+        """Attach HELP text to a metric base name, emitted as a
+        ``# HELP`` line by `render_prometheus`. Idempotent
+        (last-write-wins); describing an unused metric is harmless."""
+        with self._lock:
+            self._help[str(name)] = str(text)
+
     def reset(self) -> None:
+        """Clear all series. Descriptions survive — they are metadata
+        registered at import time, not run state."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
@@ -191,14 +213,20 @@ class MetricsRegistry:
             return out
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition (0.0.4) of the whole registry."""
+        """Prometheus text exposition (0.0.4) of the whole registry:
+        ``# HELP`` (for described metrics) + ``# TYPE`` + samples. Label
+        values arrive pre-escaped via `series_name`."""
         lines = []
         snap = self.snapshot()
+        with self._lock:
+            help_text = dict(self._help)
         seen_type: Dict[str, str] = {}
 
         def type_line(series: str, kind: str):
             base = series.split("{", 1)[0]
             if seen_type.get(base) != kind:
+                if base not in seen_type and base in help_text:
+                    lines.append(f"# HELP {base} {_escape_help(help_text[base])}")
                 seen_type[base] = kind
                 lines.append(f"# TYPE {base} {kind}")
 
@@ -275,6 +303,10 @@ def observe(
     **labels: Any,
 ) -> None:
     _REGISTRY.observe(name, value, buckets, **labels)
+
+
+def describe(name: str, text: str) -> None:
+    _REGISTRY.describe(name, text)
 
 
 def histogram_quantile(name: str, q: float, **labels: Any) -> Optional[float]:

@@ -488,6 +488,10 @@ class SlotEngine:
         self._it_mark = np.zeros(bucket, np.int32)
         self.chunks = 0
         self.refills = 0
+        # optional chunk-loop observer (obs.reqtrace.EngineJourneyObserver
+        # duck type: chunk_begin / cold_end / compute_end / harvest_end).
+        # None keeps the hot path branch-free of tracing work.
+        self.observer = None
 
     # -- slot management ----------------------------------------------
     def free_slots(self) -> int:
@@ -603,6 +607,9 @@ class SlotEngine:
 
         if not any(t is not None for t in self._tokens):
             return []
+        watch = self.observer
+        if watch is not None:
+            watch.chunk_begin(self._tokens)
         if self._dirty:
             self._d_cur = self._stack()
             self._dirty = False
@@ -623,11 +630,14 @@ class SlotEngine:
                 else np.asarray(self._fresh)
             )
             self._st = self._scatter()(base, st0, sel)
+            if watch is not None:
+                watch.cold_end(self._tokens, self._fresh)
             self._fresh = [False] * self.bucket
 
         # stops come from the host iteration marks, not a device read:
         # every surviving lane ran exactly to its previous stop (done lanes
         # were harvested, fresh lanes reset to 0 by the cold scatter)
+        it_before = self._it_mark
         stops = np.where(
             occupied,
             np.minimum(self._it_mark + self.chunk_iters, self.max_iter),
@@ -648,6 +658,10 @@ class SlotEngine:
         else:
             its = np.asarray(st.it)
             finished = np.asarray(st.done) | (its >= self.max_iter)
+        if watch is not None:
+            # the np.asarray above is where async dispatch blocks, so this
+            # stamp is the chunk's observable compute end
+            watch.compute_end(self._tokens, it_before, stops)
 
         out = []
         retired = 0
@@ -666,6 +680,9 @@ class SlotEngine:
             obs_metrics.inc(
                 "adaptive_lanes_retired_total", retired, entry=self.entry
             )
+            if watch is not None:
+                # after the _sol_rows() harvest transfer completed
+                watch.harvest_end([tok for tok, _, _ in out])
         return out
 
 

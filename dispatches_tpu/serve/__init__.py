@@ -18,6 +18,7 @@ from .request import (
     SolveRequest,
     SolveResult,
     Ticket,
+    priority_name,
     priority_value,
 )
 from .service import DispatchService, make_dense_service
@@ -31,5 +32,6 @@ __all__ = [
     "SolveResult",
     "Ticket",
     "make_dense_service",
+    "priority_name",
     "priority_value",
 ]

@@ -34,20 +34,27 @@ class AdmissionQueue:
         return (req for _, req in self._q)
 
     def push(
-        self, req: SolveRequest
+        self, req: SolveRequest, now: Optional[float] = None
     ) -> Tuple[bool, Optional[SolveRequest]]:
         """Try to enqueue. Returns ``(admitted, shed)``: `shed` is the
         displaced lowest-priority request when the newcomer bumped one
-        out, or `req` itself when it was rejected at the door."""
+        out, or `req` itself when it was rejected at the door. `now`
+        stamps the admitted request's journey ``enqueued`` boundary (a
+        rejected newcomer never entered the queue, so it gets none)."""
         if len(self._q) < self.limit:
-            bisect.insort(self._q, (req.sort_key(), req))
+            self._insort(req, now)
             return True, None
         worst_key, worst = self._q[-1]
         if req.sort_key() < worst_key:
             self._q.pop()
-            bisect.insort(self._q, (req.sort_key(), req))
+            self._insort(req, now)
             return True, worst
         return False, req
+
+    def _insort(self, req: SolveRequest, now: Optional[float]) -> None:
+        if req.journey is not None and now is not None:
+            req.journey.mark("enqueued", now)
+        bisect.insort(self._q, (req.sort_key(), req))
 
     def pop(self) -> Optional[SolveRequest]:
         """Most-urgent pending request, or None when empty."""

@@ -30,6 +30,15 @@ def priority_value(priority) -> int:
     return int(priority)
 
 
+_PRIORITY_NAMES = {v: k for k, v in PRIORITY_CLASSES.items()}
+
+
+def priority_name(priority: int) -> str:
+    """Class name for a priority value (journey/metric label); ints
+    outside the table render as their decimal string."""
+    return _PRIORITY_NAMES.get(int(priority), str(int(priority)))
+
+
 class SolveResult(NamedTuple):
     """What a `Ticket` resolves to.
 
@@ -57,7 +66,7 @@ class SolveResult(NamedTuple):
 class SolveRequest:
     __slots__ = (
         "problem", "priority", "deadline", "fingerprint", "request_id",
-        "seq", "submitted_at", "started_at", "ticket",
+        "seq", "submitted_at", "started_at", "ticket", "journey",
     )
 
     def __init__(
@@ -78,6 +87,9 @@ class SolveRequest:
         self.submitted_at: Optional[float] = None
         self.started_at: Optional[float] = None
         self.ticket: Optional["Ticket"] = None
+        # obs.reqtrace.Journey when the service runs with reqtrace=True;
+        # None otherwise (the off path never touches it)
+        self.journey: Optional[Any] = None
 
     def sort_key(self):
         # FIFO within a priority class; seq is service-assigned and unique

@@ -32,15 +32,39 @@ import time
 from typing import Any, Dict, Optional
 
 from ..obs import health as obs_health
+from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
+from ..obs import reqtrace as obs_reqtrace
 from ..obs.journal import get_tracer
 from .cache import ResultCache
 from .queue import AdmissionQueue
-from .request import SolveRequest, SolveResult, Ticket, priority_value
+from .request import (
+    SolveRequest,
+    SolveResult,
+    Ticket,
+    priority_name,
+    priority_value,
+)
 
 LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+obs_metrics.describe(
+    "serve_requests_total",
+    "Requests resolved, by terminal status (ok/cached/shed/deadline_exceeded).",
+)
+obs_metrics.describe(
+    "serve_latency_seconds", "End-to-end request latency, by terminal status.",
+)
+obs_metrics.describe("serve_queue_depth", "Pending requests in the admission queue.")
+obs_metrics.describe("serve_active_lanes", "Engine lanes currently occupied.")
+obs_metrics.describe("serve_shed_total", "Requests shed by admission control.")
+obs_metrics.describe("serve_deadline_total", "Requests that missed their deadline.")
+obs_metrics.describe(
+    "serve_mem_watermark_bytes",
+    "Peak device memory observed from the service pump loop.",
 )
 
 
@@ -66,12 +90,21 @@ class DispatchService:
         cache: Optional[ResultCache] = None,
         clock=time.monotonic,
         name: str = "serve",
+        reqtrace: bool = False,
+        mem_sample_every: int = 32,
     ):
         self.engine = engine
         self.queue = AdmissionQueue(queue_limit)
         self.cache = cache
         self.clock = clock
         self.name = name
+        self.reqtrace = bool(reqtrace)
+        if self.reqtrace:
+            # engine chunk-loop boundaries stamp onto request journeys,
+            # sharing the service clock; None keeps the hot path untouched
+            engine.observer = obs_reqtrace.EngineJourneyObserver(clock)
+        self.mem_sample_every = int(mem_sample_every)
+        self._pump_count = 0
         self._lock = threading.RLock()
         self._seq = 0
         self._thread: Optional[threading.Thread] = None
@@ -91,10 +124,14 @@ class DispatchService:
         fingerprint: Optional[str] = None,
         options: Optional[Dict] = None,
         request_id: Optional[str] = None,
+        trace_ctx: Any = None,
     ) -> Ticket:
         """Queue one problem row. `timeout` is seconds-from-now sugar for
         an absolute `deadline`. The returned ticket may already be done:
-        cache hits and admission-shed requests resolve synchronously."""
+        cache hits and admission-shed requests resolve synchronously.
+        `trace_ctx` (a `TraceContext` or serialized traceparent string)
+        parents this request's journey onto a caller span; it is ignored
+        unless the service runs with ``reqtrace=True``."""
         now = self.clock()
         if deadline is None and timeout is not None:
             deadline = now + timeout
@@ -105,17 +142,25 @@ class DispatchService:
             fingerprint=self._fingerprint(problem, fingerprint, options),
             request_id=request_id,
         )
+        if self.reqtrace:
+            req.journey = obs_reqtrace.start_journey(
+                trace_ctx, clock=self.clock, t0=now,
+                request_id=request_id,
+                priority=priority_name(req.priority),
+            )
         ticket = Ticket(req)
         with self._lock:
             req.seq = self._seq
             self._seq += 1
             req.submitted_at = now
+            if req.journey is not None:
+                req.journey.seq = req.seq
             if self.cache is not None:
                 hit = self.cache.get(req.fingerprint)
                 if hit is not None:
                     self._resolve_cached(req, hit, now)
                     return ticket
-            admitted, shed = self.queue.push(req)
+            admitted, shed = self.queue.push(req, now=now)
             if shed is not None:
                 self._resolve_shed(shed)
             obs_metrics.set_gauge("serve_queue_depth", len(self.queue))
@@ -162,11 +207,15 @@ class DispatchService:
         with self._lock:
             now = self.clock()
             for req in self.queue.remove_expired(now):
+                if req.journey is not None:
+                    req.journey.mark("dequeued", now)
                 self._resolve_deadline(req, solution=None, iterations=None)
                 done += 1
             while self.engine.free_slots() and len(self.queue):
                 req = self.queue.pop()
                 req.started_at = now
+                if req.journey is not None:
+                    req.journey.mark("slot", now)
                 self.engine.admit(req, req.problem)
             if self.engine.active():
                 for req, row, stats in self.engine.step():
@@ -177,12 +226,24 @@ class DispatchService:
                     r for r in self.engine.active() if r.expired(now)
                 ]:
                     row = self.engine.evict(req)
+                    if req.journey is not None and row is not None:
+                        req.journey.mark("harvest_end")
                     self._resolve_deadline(
                         req, solution=row,
                         iterations=None if row is None
                         else int(row.iterations),
                     )
                     done += 1
+            self._pump_count += 1
+            if self.mem_sample_every and (
+                self._pump_count % self.mem_sample_every
+                == 1 % self.mem_sample_every  # first pump, then every Nth
+            ):
+                # serve-tier OOM drift: watermark gauge lands in the
+                # journal close snapshot with the rest of the registry
+                wm = obs_memory.memory_watermark_bytes()
+                if wm is not None:
+                    obs_metrics.set_gauge("serve_mem_watermark_bytes", wm)
             obs_metrics.set_gauge("serve_queue_depth", len(self.queue))
             obs_metrics.set_gauge(
                 "serve_active_lanes", len(self.engine.active())
@@ -235,12 +296,18 @@ class DispatchService:
     # -- completions ---------------------------------------------------
     def _resolve_cached(self, req, hit: SolveResult, now: float) -> None:
         self.completed += 1
-        latency = self.clock() - now
+        done_at = self.clock()
+        latency = done_at - now
         obs_metrics.inc("serve_requests_total", status="cached")
         obs_metrics.observe(
             "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
             status="cached",
         )
+        if req.journey is not None:
+            req.journey.finish(
+                "cache_hit", verdict=hit.verdict,
+                iterations=hit.iterations, now=done_at, from_cache=True,
+            )
         req.ticket._complete(hit._replace(
             from_cache=True, latency=latency, request_id=req.request_id,
         ))
@@ -270,12 +337,18 @@ class DispatchService:
             request_id=req.request_id, seq=req.seq,
             latency_s=latency, iterations=stats.get("iterations"),
         )
+        if req.journey is not None:
+            req.journey.finish(
+                "complete", verdict=verdict,
+                iterations=stats.get("iterations"), now=now,
+            )
         req.ticket._complete(result)
 
     def _resolve_deadline(self, req, solution, iterations) -> None:
         self.completed += 1
         self.deadline_total += 1
-        latency = self.clock() - req.submitted_at
+        now = self.clock()
+        latency = now - req.submitted_at
         obs_metrics.inc("serve_requests_total", status="deadline_exceeded")
         obs_metrics.inc("serve_deadline_total")
         obs_metrics.observe(
@@ -302,6 +375,12 @@ class DispatchService:
             obs_health.note_verdicts(
                 {"deadline_exceeded": 1}, solve=self.name
             )
+        if req.journey is not None:
+            req.journey.finish(
+                "deadline_exceeded", verdict="deadline_exceeded",
+                iterations=iterations, now=now,
+                best_iterate=solution is not None,
+            )
         req.ticket._complete(SolveResult(
             solution=solution,
             verdict="deadline_exceeded",
@@ -313,6 +392,8 @@ class DispatchService:
     def _resolve_shed(self, req) -> None:
         self.completed += 1
         self.shed_total += 1
+        now = self.clock()
+        latency = now - req.submitted_at
         obs_metrics.inc("serve_requests_total", status="shed")
         obs_metrics.inc("serve_shed_total")
         get_tracer().event(
@@ -320,10 +401,15 @@ class DispatchService:
             request_id=req.request_id, seq=req.seq, priority=req.priority,
         )
         obs_health.note_verdicts({"shed": 1}, solve=self.name)
+        if req.journey is not None:
+            # a displaced request's queue residency ends here
+            if "enqueued" in req.journey.marks:
+                req.journey.mark("dequeued", now)
+            req.journey.finish("shed", verdict="shed", now=now)
         req.ticket._complete(SolveResult(
             solution=None,
             verdict="shed",
-            latency=self.clock() - req.submitted_at,
+            latency=latency,
             request_id=req.request_id,
         ))
 
@@ -361,6 +447,7 @@ def make_dense_service(
     cache_size: Optional[int] = 256,
     clock=time.monotonic,
     trace: bool = False,
+    reqtrace: bool = False,
     **solver_kw,
 ) -> DispatchService:
     """A `DispatchService` over dense `LPData` rows solved by the IPM:
@@ -383,4 +470,5 @@ def make_dense_service(
     cache = ResultCache(cache_size) if cache_size else None
     return DispatchService(
         engine, queue_limit=queue_limit, cache=cache, clock=clock,
+        reqtrace=reqtrace,
     )

@@ -276,7 +276,7 @@ class TestCostModel:
 class TestJournalV2:
     def test_manifest_schema_version_and_mono(self):
         tracer = Tracer(None)
-        assert tracer.manifest["schema_version"] == 2
+        assert tracer.manifest["schema_version"] == 3
         assert tracer.manifest["clock"] == "perf_counter"
         with tracer.span("a"):
             pass

@@ -1,0 +1,491 @@
+"""Request-journey tracing & SLOs (ISSUE 6): TraceContext round-trips,
+exact phase attribution for every terminal under a fake clock, bitwise
+neutrality of the disabled path, SLO burn-rate math, Prometheus HELP /
+label escaping, the pump-loop memory watermark, the trace_timeline
+exporter, journal_diff journey extraction, and cross-process traceparent
+propagation through the serve_dispatch JSONL front door."""
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.obs.journal import Tracer, read_journal, use_tracer
+from dispatches_tpu.obs.metrics import MetricsRegistry, reset_metrics, snapshot
+from dispatches_tpu.obs.reqtrace import (
+    TERMINALS,
+    TRACEPARENT_ENV,
+    Journey,
+    TraceContext,
+    coerce_context,
+    start_journey,
+)
+from dispatches_tpu.obs import slo as obs_slo
+from dispatches_tpu.serve import make_dense_service
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _lp(seed, n=6, m=3, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    return LPData(
+        jnp.asarray(A, dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _svc(clock, bucket=2, queue_limit=4, reqtrace=True, **kw):
+    kw.setdefault("max_iter", 40)
+    return make_dense_service(
+        bucket, chunk_iters=kw.pop("chunk_iters", 4),
+        queue_limit=queue_limit, cache_size=kw.pop("cache_size", 8),
+        clock=clock, reqtrace=reqtrace, **kw,
+    )
+
+
+# ---------------------------------------------------------------------
+# trace context
+# ---------------------------------------------------------------------
+class TestTraceContext:
+    def test_roundtrip(self):
+        ctx = TraceContext.new()
+        back = TraceContext.from_traceparent(ctx.to_traceparent())
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+
+    @pytest.mark.parametrize("bad", [
+        None, 42, "", "not-a-traceparent",
+        "00-zz" + "0" * 30 + "-" + "1" * 16 + "-01",       # non-hex
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",          # short trace id
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",          # all-zero trace
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",          # all-zero span
+    ])
+    def test_malformed_rejected(self, bad):
+        assert TraceContext.from_traceparent(bad) is None
+
+    def test_child_lineage(self):
+        root = TraceContext.new()
+        kid = root.child()
+        assert kid.trace_id == root.trace_id
+        assert kid.parent_span_id == root.span_id
+        assert kid.span_id != root.span_id
+
+    def test_from_environ(self):
+        ctx = TraceContext.new()
+        env = {TRACEPARENT_ENV: ctx.to_traceparent()}
+        got = TraceContext.from_environ(env)
+        assert (got.trace_id, got.span_id) == (ctx.trace_id, ctx.span_id)
+        assert TraceContext.from_environ({}) is None
+
+    def test_coerce(self):
+        ctx = TraceContext.new()
+        assert coerce_context(ctx) is ctx
+        assert coerce_context(ctx.to_traceparent()).trace_id == ctx.trace_id
+        assert coerce_context("junk") is None
+
+    def test_start_journey_parents_incoming(self):
+        clock = FakeClock()
+        caller = TraceContext.new()
+        j = start_journey(caller.to_traceparent(), clock=clock, t0=0.0)
+        assert j.ctx.trace_id == caller.trace_id
+        assert j.ctx.parent_span_id == caller.span_id
+        root = start_journey(None, clock=clock, t0=0.0)
+        assert root.ctx.parent_span_id is None
+
+
+# ---------------------------------------------------------------------
+# phase attribution (unit level)
+# ---------------------------------------------------------------------
+class TestJourneyPhases:
+    def test_phases_sum_exactly_for_full_walk(self):
+        clock = FakeClock()
+        j = Journey(TraceContext.new(), clock=clock, t0=0.0)
+        for mark, t in [("enqueued", 0.5), ("slot", 1.5),
+                        ("first_chunk", 1.75), ("compute_end", 3.0),
+                        ("harvest_end", 3.25)]:
+            j.mark(mark, t)
+        phases = j.phase_durations(4.0)
+        assert phases == {
+            "admit_s": 0.5, "queue_wait_s": 1.0, "slot_admit_s": 0.25,
+            "compute_s": 1.25, "harvest_s": 0.25, "respond_s": 0.75,
+        }
+        assert sum(phases.values()) == 4.0
+
+    def test_partial_walk_tail_is_respond(self):
+        # a shed request crossed only the queue boundaries
+        clock = FakeClock()
+        j = Journey(TraceContext.new(), clock=clock, t0=1.0)
+        j.mark("enqueued", 1.0)
+        j.mark("dequeued", 2.0)
+        phases = j.phase_durations(2.5)
+        assert set(phases) == {"admit_s", "queue_wait_s", "respond_s"}
+        assert sum(phases.values()) == 1.5
+
+    def test_first_mark_wins(self):
+        j = Journey(TraceContext.new(), clock=FakeClock(), t0=0.0)
+        j.mark("enqueued", 1.0)
+        j.mark("enqueued", 9.0)
+        assert j.marks["enqueued"] == 1.0
+
+    def test_finish_is_idempotent(self):
+        j = Journey(TraceContext.new(), clock=FakeClock(), t0=0.0)
+        assert j.finish("complete", now=1.0) is not None
+        assert j.finish("shed", now=2.0) is None
+        assert j.terminal == "complete"
+
+
+# ---------------------------------------------------------------------
+# end-to-end: every terminal produces a complete journey
+# ---------------------------------------------------------------------
+class TestServiceJourneys:
+    def _run_all_terminals(self, tmp_path):
+        reset_metrics()
+        path = tmp_path / "journeys.jsonl"
+        clock = FakeClock()
+        caller = TraceContext.new()
+        tracer = Tracer(str(path))
+        with use_tracer(tracer):
+            svc = _svc(clock, queue_limit=1)
+            tickets = {}
+            # queued deadline: expires before any pump
+            tickets["late"] = svc.submit(_lp(0), timeout=0.0,
+                                         request_id="late")
+            # shed at the door: queue holds "late", equal priority loses
+            tickets["gone"] = svc.submit(_lp(1), request_id="gone")
+            clock.advance(0.01)
+            svc.drain()
+            # completed solve, parented on the caller's context
+            tickets["ok"] = svc.submit(
+                _lp(2), request_id="ok",
+                trace_ctx=caller.to_traceparent(),
+            )
+            svc.drain()
+            # cache hit: same problem again
+            tickets["hit"] = svc.submit(_lp(2), request_id="hit")
+            svc.drain()
+        recs = read_journal(str(path))
+        journeys = {r["request_id"]: r for r in recs
+                    if r.get("kind") == "journey"}
+        return tickets, journeys, recs
+
+    def test_all_terminals_and_exact_phase_sums(self, tmp_path):
+        tickets, journeys, _ = self._run_all_terminals(tmp_path)
+        assert set(journeys) == {"late", "gone", "ok", "hit"}
+        terminals = {j["terminal"] for j in journeys.values()}
+        assert terminals == set(TERMINALS)
+        for rid, t in tickets.items():
+            j = journeys[rid]
+            res = t.result(timeout=0)
+            # the journey's latency is the ticket's latency...
+            assert j["latency_s"] == pytest.approx(res.latency, abs=1e-12)
+            # ...and the phases sum to it exactly (shared fake clock)
+            assert sum(j["phases"].values()) == pytest.approx(
+                j["latency_s"], abs=1e-12)
+
+    def test_lineage_and_chunks(self, tmp_path):
+        _, journeys, recs = self._run_all_terminals(tmp_path)
+        ok = journeys["ok"]
+        # parented on the caller's span; others are fresh roots
+        assert ok["parent_span_id"] is not None
+        assert journeys["late"]["parent_span_id"] is None
+        # the solved request rode at least one engine chunk on a slot
+        assert ok["chunks"] and ok["slot"] is not None
+        for c in ok["chunks"]:
+            assert c["it1"] >= c["it0"] >= 0
+        # cache hit never touched the engine
+        assert journeys["hit"]["chunks"] == []
+        assert journeys["hit"].get("from_cache") is True
+
+    def test_phase_histograms_land_in_registry(self, tmp_path):
+        self._run_all_terminals(tmp_path)
+        snap = snapshot()["histograms"]
+        assert any(s.startswith("serve_queue_wait_seconds") for s in snap)
+        assert any(s.startswith("serve_compute_seconds") for s in snap)
+        assert any(s.startswith("serve_transfer_seconds") for s in snap)
+
+    def test_disabled_path_is_bitwise_neutral(self):
+        results = {}
+        for reqtrace in (False, True):
+            reset_metrics()
+            svc = _svc(FakeClock(), reqtrace=reqtrace, cache_size=None)
+            t = svc.submit(_lp(7), request_id="r")
+            svc.drain()
+            results[reqtrace] = t.result(timeout=0)
+            if not reqtrace:
+                assert svc.engine.observer is None
+                assert t.request.journey is None
+        a, b = results[False].solution, results[True].solution
+        for name, x, y in zip(a._fields, a, b):
+            assert _biteq(x, y), name
+
+
+# ---------------------------------------------------------------------
+# satellite: pump-loop device-memory watermark
+# ---------------------------------------------------------------------
+class TestMemWatermark:
+    def test_pump_samples_watermark_gauge(self, monkeypatch):
+        from dispatches_tpu.serve import service as svc_mod
+
+        reset_metrics()
+        monkeypatch.setattr(
+            svc_mod.obs_memory, "memory_watermark_bytes", lambda: 12345
+        )
+        svc = _svc(FakeClock(), reqtrace=False)
+        svc.submit(_lp(0))
+        svc.drain()
+        assert snapshot()["gauges"]["serve_mem_watermark_bytes"] == 12345
+
+    def test_no_backend_is_silent(self, monkeypatch):
+        from dispatches_tpu.serve import service as svc_mod
+
+        reset_metrics()
+        monkeypatch.setattr(
+            svc_mod.obs_memory, "memory_watermark_bytes", lambda: None
+        )
+        svc = _svc(FakeClock(), reqtrace=False)
+        svc.submit(_lp(0))
+        svc.drain()
+        assert "serve_mem_watermark_bytes" not in snapshot()["gauges"]
+
+
+# ---------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------
+def _journey(terminal="complete", latency=0.01, t0=100.0, priority="normal"):
+    return {"kind": "journey", "terminal": terminal, "priority": priority,
+            "t0": t0, "latency_s": latency}
+
+
+class TestSLO:
+    def test_clean_traffic_burns_nothing(self):
+        recs = [_journey(t0=100.0 + i * 0.01) for i in range(50)]
+        slo = obs_slo.SLO("normal", 0.25, 0.99, "normal")
+        report = obs_slo.evaluate_slos(recs, slos=[slo])
+        assert obs_slo.worst_burn_rate(report) == 0.0
+        assert obs_slo.breaches(report) == []
+
+    def test_latency_misses_burn_budget(self):
+        # 2 of 100 over the objective against a 1% budget => burn 2.0
+        recs = [_journey(latency=0.01, t0=100 + i * 0.001) for i in range(98)]
+        recs += [_journey(latency=1.0, t0=100.2), _journey(latency=1.0, t0=100.3)]
+        slo = obs_slo.SLO("normal", 0.25, 0.99, "normal")
+        report = obs_slo.evaluate_slos(recs, slos=[slo])
+        assert obs_slo.worst_burn_rate(report) == pytest.approx(2.0)
+        assert obs_slo.breaches(report, max_burn=1.0)
+
+    def test_bad_terminals_count_against_budget(self):
+        recs = [_journey(t0=100 + i * 0.001) for i in range(99)]
+        recs.append(_journey(terminal="shed", latency=0.001, t0=100.5))
+        slo = obs_slo.SLO("normal", 10.0, 0.99, "normal")  # latency never bad
+        report = obs_slo.evaluate_slos(recs, slos=[slo])
+        assert obs_slo.worst_burn_rate(report) == pytest.approx(1.0)
+
+    def test_windows_anchor_at_latest_completion(self):
+        # an old failure outside the 1m window must not burn it
+        recs = [_journey(terminal="deadline_exceeded", t0=0.0)]
+        recs += [_journey(t0=1000.0 + i) for i in range(10)]
+        slo = obs_slo.SLO("normal", 0.25, 0.99, "normal")
+        report = obs_slo.evaluate_slos(recs, slos=[slo])
+        wins = report["normal"]["windows"]
+        assert wins["1m"]["bad"] == 0
+        assert wins["1h"]["bad"] == 1
+
+    def test_priority_filter(self):
+        recs = [_journey(priority="batch", latency=5.0, t0=100 + i)
+                for i in range(10)]
+        slo = obs_slo.SLO("interactive", 0.05, 0.99, "interactive")
+        report = obs_slo.evaluate_slos(recs, slos=[slo])
+        assert obs_slo.worst_burn_rate(report) == 0.0  # no matching events
+
+
+# ---------------------------------------------------------------------
+# satellite: Prometheus HELP lines + exposition-format escaping
+# ---------------------------------------------------------------------
+class TestPrometheusRender:
+    def test_help_lines_precede_type(self):
+        reg = MetricsRegistry()
+        reg.describe("requests_total", "Total requests seen.")
+        reg.inc("requests_total", 2)
+        reg.inc("undescribed_total")
+        text = reg.render_prometheus()
+        lines = text.splitlines()
+        i_help = lines.index("# HELP requests_total Total requests seen.")
+        i_type = lines.index("# TYPE requests_total counter")
+        assert i_help == i_type - 1
+        assert not any(l.startswith("# HELP undescribed_total") for l in lines)
+
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry()
+        reg.inc("odd_total", route='a"b\\c\nd')
+        text = reg.render_prometheus()
+        assert 'odd_total{route="a\\"b\\\\c\\nd"} 1' in text
+        # a raw newline in the value must never split the physical line
+        assert len([l for l in text.splitlines() if "odd_total" in l]) == 2
+        # (the TYPE line plus exactly one series line)
+
+    def test_help_text_escaping(self):
+        reg = MetricsRegistry()
+        reg.describe("m_total", "line one\nback\\slash")
+        reg.inc("m_total")
+        text = reg.render_prometheus()
+        assert "# HELP m_total line one\\nback\\\\slash" in text
+
+    def test_descriptions_survive_reset(self):
+        reg = MetricsRegistry()
+        reg.describe("kept_total", "Still documented.")
+        reg.inc("kept_total")
+        reg.reset()
+        reg.inc("kept_total")
+        assert "# HELP kept_total Still documented." in reg.render_prometheus()
+
+
+# ---------------------------------------------------------------------
+# tools: timeline export + journal_diff journey extraction
+# ---------------------------------------------------------------------
+class TestTraceTimeline:
+    def test_self_check(self, capsys):
+        tt = _tool("trace_timeline")
+        assert tt.self_check() == 0
+        assert "FAIL" not in capsys.readouterr().out
+
+    def test_export_from_real_service(self, tmp_path):
+        reset_metrics()
+        path = tmp_path / "svc.jsonl"
+        tracer = Tracer(str(path))
+        with use_tracer(tracer):
+            svc = _svc(FakeClock())
+            for i in range(3):
+                svc.submit(_lp(20 + i), request_id=f"r{i}")
+            svc.drain()
+        tracer.close()
+        tt = _tool("trace_timeline")
+        records = tt.read_jsonl(str(path))
+        trace = tt.export_trace(records)
+        assert tt.validate_trace(trace) == []
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert spans  # chunk/queue spans for the completed requests
+        out = tmp_path / "t.trace.json"
+        assert tt.main([str(path), "-o", str(out)]) == 0
+        assert json.loads(out.read_text())["traceEvents"]
+
+    def test_pre_v3_journal_exits_2(self, tmp_path):
+        p = tmp_path / "old.jsonl"
+        p.write_text(json.dumps({"kind": "manifest", "schema_version": 2}) + "\n")
+        tt = _tool("trace_timeline")
+        assert tt.main([str(p)]) == 2
+
+
+class TestJournalDiffJourneys:
+    def test_journey_metrics_extracted(self):
+        jd = _tool("journal_diff")
+        recs = [{"kind": "manifest"}]
+        for i in range(20):
+            recs.append({"kind": "journey", "terminal": "complete",
+                         "priority": "normal", "latency_s": 0.01 + i * 1e-4,
+                         "phases": {"queue_wait_s": 0.002}})
+        recs.append({"kind": "journey", "terminal": "shed",
+                     "priority": "batch", "latency_s": 0.5,
+                     "phases": {"queue_wait_s": 0.5}})
+        m = jd.metrics_from_journal(recs)
+        assert m["journey/terminal/complete"] == 20.0
+        assert m["journey/terminal/shed"] == 1.0
+        assert m["journey/normal/latency_p95_s"] == pytest.approx(0.0118)
+        assert m["journey/normal/queue_wait_p95_s"] == pytest.approx(0.002)
+        assert m["journey/batch/queue_wait_p95_s"] == pytest.approx(0.5)
+
+    def test_directions(self):
+        jd = _tool("journal_diff")
+        assert jd.lower_is_better("journey/normal/queue_wait_p95_s")
+        assert jd.lower_is_better("serve/slo/normal/burn_rate")
+        assert jd.lower_is_better("journey/terminal/shed")
+        assert not jd.lower_is_better("journey/terminal/complete")
+        assert not jd.lower_is_better("journey/terminal/cache_hit")
+
+    def test_bad_terminal_gates_from_zero(self):
+        jd = _tool("journal_diff")
+        base = {"journey/terminal/complete": 10.0}
+        new = {"journey/terminal/complete": 10.0,
+               "journey/terminal/deadline_exceeded": 1.0}
+        rows = jd.compare(base, new)
+        bad = [r for r in rows if "deadline" in r["metric"]]
+        assert bad and bad[0]["regression"]
+
+
+# ---------------------------------------------------------------------
+# cross-process propagation through the serve_dispatch JSONL front door
+# ---------------------------------------------------------------------
+class TestCrossProcessPropagation:
+    def test_traceparent_round_trip(self, tmp_path):
+        caller = TraceContext.new()
+        journal = tmp_path / "child.jsonl"
+        reqfile = tmp_path / "requests.jsonl"
+        problem = {"A": [[1.0, 1.0]], "b": [1.5], "c": [-1.0, -0.5],
+                   "l": [0.0, 0.0], "u": [1.0, 1.0], "c0": 0.0}
+        reqfile.write_text(json.dumps({
+            "op": "solve", "id": "xp1", "problem": problem,
+            "traceparent": caller.to_traceparent(),
+        }) + "\n")
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            **{TRACEPARENT_ENV: caller.to_traceparent()},
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_dispatch.py"),
+             "--input", str(reqfile), "--bucket", "2", "--chunk-iters", "4",
+             "--max-iter", "40", "--reqtrace", "--journal", str(journal)],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        responses = [json.loads(l) for l in proc.stdout.splitlines() if l]
+        resp = next(r for r in responses if r.get("id") == "xp1")
+        assert "error" not in resp
+        # the response's journey parents onto the caller's span, same trace
+        child_ctx = TraceContext.from_traceparent(resp["traceparent"])
+        assert child_ctx.trace_id == caller.trace_id
+        assert resp["parent_span_id"] == caller.span_id
+        # the child's journal agrees: journey record carries the lineage
+        recs = read_journal(str(journal))
+        j = next(r for r in recs if r.get("kind") == "journey")
+        assert j["request_id"] == "xp1"
+        assert j["trace_id"] == caller.trace_id
+        assert j["parent_span_id"] == caller.span_id
+        assert sum(j["phases"].values()) == pytest.approx(
+            j["latency_s"], rel=0, abs=1e-9)
+        # ...and the manifest parents the whole run via the env var
+        man = next(r for r in recs if r.get("kind") == "manifest")
+        assert man["trace_id"] == caller.trace_id
+        assert man["parent_span_id"] == caller.span_id

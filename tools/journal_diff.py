@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -52,12 +53,18 @@ DEFAULT_THRESHOLD = 0.10
 # verdict="healthy" counters count GOOD solves; every other verdict label
 # (diverged/stalled/nonfinite/hang/failed) falls through to the
 # lower-is-better default, so a bad verdict appearing from zero trips the
-# gate with change=+inf
+# gate with change=+inf. "terminal/complete" covers schema-v3 journey
+# terminal counts (more completed journeys is good); shed /
+# deadline_exceeded terminals, latency/queue-wait p95s and SLO burn
+# rates all fall through to lower-is-better.
 _HIGHER_IS_BETTER = (
     "per_sec", "per_chip", "converged", "mfu", "tflops", "utilization",
     "throughput", 'verdict="healthy"', "iters_saved", "cache_hit",
-    "lanes_retired", "goodput",
+    "lanes_retired", "goodput", "terminal/complete",
 )
+
+# metrics zero-seeded on whichever side lacks them (see compare())
+_ZERO_SEEDED = ("solve_verdict_total", "journey/terminal/", "burn_rate")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -110,13 +117,59 @@ def _last_run(records: List[dict]) -> List[dict]:
     return records[starts[-1]:] if starts else records
 
 
+def _p95(values: List[float]) -> float:
+    """Nearest-rank p95 of raw samples."""
+    s = sorted(values)
+    return s[max(0, math.ceil(0.95 * len(s)) - 1)]
+
+
+def _hist_p95(h: Any) -> Optional[float]:
+    """p95 from a close-snapshot histogram (``{count, buckets: {le: n}}``,
+    per-bucket counts) — same linear interpolation within the containing
+    bucket as `MetricsRegistry.histogram_quantile`, with the +Inf tail
+    clamped to the largest finite bound."""
+    if not isinstance(h, dict):
+        return None
+    count = h.get("count")
+    raw = h.get("buckets")
+    if not _is_num(count) or count <= 0 or not isinstance(raw, dict):
+        return None
+    try:
+        pairs = sorted(
+            (float("inf") if str(b).lstrip("+") in ("Inf", "inf") else float(b),
+             float(c))
+            for b, c in raw.items() if _is_num(c)
+        )
+    except (TypeError, ValueError):
+        return None
+    rank = 0.95 * count
+    cum = 0.0
+    lo = 0.0
+    for bound, c in pairs:
+        prev = cum
+        cum += c
+        if cum >= rank:
+            if bound == float("inf"):
+                return lo
+            frac = (rank - prev) / c if c else 0.0
+            return lo + (bound - lo) * frac
+        if bound != float("inf"):
+            lo = bound
+    return None
+
+
 def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
     """The comparable surface of one journal run.
 
     Repeated spans/solves with the same name (sweep loops) are aggregated:
     wall-clock, retraces, FLOPs and counters sum; memory watermarks max.
+    Schema-v3 ``journey`` records contribute per-priority latency /
+    queue-wait p95s and per-terminal counts; close-snapshot serve_*
+    histograms contribute a ``metric/<series>/p95`` estimate.
     """
     out: Dict[str, float] = {}
+    lat_by_pri: Dict[str, List[float]] = {}
+    qw_by_pri: Dict[str, List[float]] = {}
 
     def add(key: str, v: float) -> None:
         out[key] = out.get(key, 0.0) + v
@@ -176,6 +229,17 @@ def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
                 if isinstance(rl, dict) and _is_num(rl.get("utilization")):
                     hi(f"solve/{name}/cost/utilization",
                        float(rl["utilization"]))
+        elif kind == "journey":
+            term = rec.get("terminal")
+            if isinstance(term, str) and term:
+                add(f"journey/terminal/{term}", 1.0)
+            pri = str(rec.get("priority") or "?")
+            if _is_num(rec.get("latency_s")):
+                lat_by_pri.setdefault(pri, []).append(float(rec["latency_s"]))
+            phases = rec.get("phases")
+            if isinstance(phases, dict) and _is_num(phases.get("queue_wait_s")):
+                qw_by_pri.setdefault(pri, []).append(
+                    float(phases["queue_wait_s"]))
         elif kind == "close":
             totals = rec.get("retrace_totals")
             if isinstance(totals, dict):
@@ -186,6 +250,15 @@ def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
                 for series, v in (mets.get("counters") or {}).items():
                     if _is_num(v):
                         add(f"metric/{series}", float(v))
+                for series, h in (mets.get("histograms") or {}).items():
+                    if series.startswith("serve_"):
+                        p = _hist_p95(h)
+                        if p is not None:
+                            out[f"metric/{series}/p95"] = p
+    for pri, vs in lat_by_pri.items():
+        out[f"journey/{pri}/latency_p95_s"] = _p95(vs)
+    for pri, vs in qw_by_pri.items():
+        out[f"journey/{pri}/queue_wait_p95_s"] = _p95(vs)
     return out
 
 
@@ -229,15 +302,20 @@ def compare(
     """Per-common-metric comparison rows; `regression=True` where NEW is
     worse than BASELINE by more than the metric's threshold.
 
-    Health verdict counters (`solve_verdict_total{...}`) are zero-seeded on
-    whichever side lacks them: counters only exist once bumped, so a clean
-    baseline has no `verdict="diverged"` series at all — without the seed, a
-    bad verdict APPEARING in NEW would silently drop out of the common-metric
-    intersection instead of tripping the appearing-from-zero gate."""
+    `_ZERO_SEEDED` metrics — health verdict counters
+    (`solve_verdict_total{...}`), journey terminal counts
+    (`journey/terminal/*`), and SLO burn rates — are zero-seeded on
+    whichever side lacks them: those series only exist once something
+    happened, so a clean baseline has no `verdict="diverged"` or
+    `journey/terminal/shed` entry at all — without the seed, a bad event
+    APPEARING in NEW would silently drop out of the common-metric
+    intersection instead of tripping the appearing-from-zero gate.
+    (Good-direction metrics appearing from zero never flag: regression is
+    suppressed for higher-is-better metrics with a zero baseline.)"""
     overrides = overrides or []
     base, new = dict(base), dict(new)
     for metric in set(base) | set(new):
-        if "solve_verdict_total" in metric:
+        if any(pat in metric for pat in _ZERO_SEEDED):
             base.setdefault(metric, 0.0)
             new.setdefault(metric, 0.0)
     rows: List[dict] = []
@@ -452,6 +530,52 @@ def self_check(out=sys.stdout) -> int:
          {**sbase,
           'metric/solve_verdict_total{solve="serve",verdict="deadline_exceeded"}':
           3.0}, True)
+
+    # request journeys + SLOs (obs.reqtrace / obs.slo, journal schema v3):
+    # queue-wait and latency p95s are lower-is-better, completed-journey
+    # counts higher-is-better, and shed/deadline terminals plus SLO burn
+    # rates gate on appearing-from-zero (zero-seeded like verdicts)
+    jbase = {
+        "journey/normal/latency_p95_s": 0.050,
+        "journey/normal/queue_wait_p95_s": 0.010,
+        'metric/serve_queue_wait_seconds{priority="normal"}/p95': 0.010,
+        "journey/terminal/complete": 200.0,
+        "serve/slo/normal/burn_rate": 0.5,
+    }
+
+    def jrun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(jbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    jrun("identical journey metrics pass", dict(jbase), False)
+    jrun("serve_queue_wait p95 regression >10% fails (lower is better)",
+         {**jbase,
+          'metric/serve_queue_wait_seconds{priority="normal"}/p95': 0.015},
+         True)
+    jrun("serve_queue_wait p95 improving passes",
+         {**jbase,
+          'metric/serve_queue_wait_seconds{priority="normal"}/p95': 0.004},
+         False)
+    jrun("per-priority journey latency p95 regression fails",
+         {**jbase, "journey/normal/latency_p95_s": 0.080}, True)
+    jrun("SLO burn rate growing >10% fails (lower is better)",
+         {**jbase, "serve/slo/normal/burn_rate": 1.2}, True)
+    jrun("SLO burn rate shrinking passes",
+         {**jbase, "serve/slo/normal/burn_rate": 0.1}, False)
+    jrun("completed-journey count dropping >10% fails (higher is better)",
+         {**jbase, "journey/terminal/complete": 150.0}, True)
+    jrun("shed terminal appearing in NEW only fails (zero-seeded)",
+         {**jbase, "journey/terminal/shed": 6.0}, True)
+    jrun("deadline terminal appearing in NEW only fails (zero-seeded)",
+         {**jbase, "journey/terminal/deadline_exceeded": 2.0}, True)
+    zb = {k: v for k, v in jbase.items() if "burn_rate" not in k}
+    rows = compare(zb, {**zb, "serve/slo/normal/burn_rate": 0.4})
+    checks.append(("SLO burn rate appearing from zero fails (zero-seeded)",
+                   True, any(r["regression"] for r in rows)))
+    rows = compare(zb, {**zb, "journey/interactive/latency_p95_s": 0.02,
+                        "journey/terminal/cache_hit": 30.0})
+    checks.append(("new priority class / cache hits appearing pass",
+                   False, any(r["regression"] for r in rows)))
 
     ok = True
     for name, want, got in checks:

@@ -112,20 +112,26 @@ def run_service(
     deadline_s: float = None,
     lp_n: int = 8,
     lp_m: int = 4,
+    reqtrace: bool = False,
+    detail: bool = False,
 ) -> dict:
-    """Drive the service at `rate` req/s; returns the report dict."""
+    """Drive the service at `rate` req/s; returns the report dict.
+    `reqtrace` records per-request journeys into the process tracer's
+    journal; `detail` adds a per-request-id latency map to the report
+    (for validation — omitted from normal reports to keep them small)."""
     _enable_x64()
     from dispatches_tpu.serve import make_dense_service
 
     svc = make_dense_service(
         bucket, chunk_iters=chunk_iters, max_iter=max_iter,
-        queue_limit=queue_limit,
+        queue_limit=queue_limit, reqtrace=reqtrace,
     )
     seeds = problem_seeds(requests, dup_frac, seed)
     problems = {s: make_problem(s, n=lp_n, m=lp_m) for s in set(seeds)}
     # warm the executables outside the measurement window (a model server
-    # would have done this at deploy time)
-    svc.submit(make_problem(10**6, n=lp_n, m=lp_m))
+    # would have done this at deploy time); batch priority keeps its
+    # compile-dominated latency out of the normal-class SLO accounting
+    svc.submit(make_problem(10**6, n=lp_n, m=lp_m), priority="batch")
     svc.drain()
     sched = arrival_schedule(requests, rate, seed)
 
@@ -170,6 +176,11 @@ def run_service(
         **_percentiles(lat),
         "service": svc.stats(),
     }
+    if detail:
+        report["latencies_by_id"] = {
+            r.request_id: r.latency for r in results
+            if r.request_id is not None and r.latency is not None
+        }
     return report
 
 
@@ -225,9 +236,129 @@ def run_serial(
     }
 
 
+def _terminal_mini_pass(out) -> dict:
+    """Deterministic pump-driven mini-scenario forcing the terminals the
+    open-loop run can't guarantee (shed, queued-deadline, cache hit).
+    Batch priority throughout, so the normal-class SLO gate below never
+    sees these intentionally bad outcomes."""
+    from dispatches_tpu.serve import make_dense_service
+
+    svc = make_dense_service(
+        2, chunk_iters=4, max_iter=40, queue_limit=1, cache_size=8,
+        reqtrace=True,
+    )
+    tickets = {}
+    # queued-deadline: expires before the first pump can grant a slot
+    tickets["mini_late"] = svc.submit(
+        make_problem(7001), priority="batch", timeout=0.0,
+        request_id="mini_late",
+    )
+    # shed at the door: queue of 1 is full and the newcomer is not more
+    # urgent than the pending request
+    tickets["mini_shed"] = svc.submit(
+        make_problem(7002), priority="batch", request_id="mini_shed",
+    )
+    svc.drain()
+    # cache hit: resolve once, then resubmit the identical problem
+    tickets["mini_a"] = svc.submit(
+        make_problem(7003), priority="batch", request_id="mini_a",
+    )
+    svc.drain()
+    tickets["mini_hit"] = svc.submit(
+        make_problem(7003), priority="batch", request_id="mini_hit",
+    )
+    svc.drain()
+    results = {rid: t.result(0) for rid, t in tickets.items()}
+    verdicts = {rid: r.verdict for rid, r in results.items()}
+    print(f"terminal mini-pass: {verdicts}", file=out)
+    return {
+        rid: r.latency for rid, r in results.items()
+        if r.latency is not None
+    }
+
+
+def _check_journeys(journal, latencies, out) -> list:
+    """Acceptance checks on the self-check journal's journey records:
+    every terminal request has a complete journey whose phase durations
+    sum to its reported latency; the timeline exporter accepts the run;
+    the normal-class SLO burn rate stays under its gate bound."""
+    from dispatches_tpu.obs import slo as obs_slo
+    from dispatches_tpu.obs.journal import read_journal
+
+    import journal_diff
+    import trace_timeline
+
+    failures = []
+    recs = read_journal(journal)
+    journeys = {
+        r.get("request_id"): r for r in recs
+        if r.get("kind") == "journey" and r.get("request_id")
+    }
+
+    missing = sorted(set(latencies) - set(journeys))
+    if missing:
+        failures.append(
+            f"{len(missing)} requests without a journey "
+            f"(first: {missing[:5]})"
+        )
+    terminals = {j.get("terminal") for j in journeys.values()}
+    for want in ("complete", "cache_hit", "shed", "deadline_exceeded"):
+        if want not in terminals:
+            failures.append(f"no journey with terminal {want!r}")
+
+    TOL = 1e-6  # float-add slack; every stamp is the same service clock
+    bad_sum = bad_lat = 0
+    for rid, j in journeys.items():
+        phases = j.get("phases") or {}
+        if abs(sum(phases.values()) - j.get("latency_s", 0.0)) > TOL:
+            bad_sum += 1
+        if rid in latencies and abs(j["latency_s"] - latencies[rid]) > TOL:
+            bad_lat += 1
+    if bad_sum:
+        failures.append(f"{bad_sum} journeys whose phases do not sum to latency")
+    if bad_lat:
+        failures.append(f"{bad_lat} journeys disagreeing with the ticket latency")
+
+    trace = trace_timeline.export_trace(recs)
+    problems = trace_timeline.validate_trace(trace)
+    if problems:
+        failures.append(f"timeline export invalid: {problems[:3]}")
+    n_spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    if not n_spans:
+        failures.append("timeline export produced no spans")
+    print(f"timeline: {n_spans} spans from {len(journeys)} journeys", file=out)
+
+    # SLO burn gate: normal-class requests (the open-loop run) against a
+    # loose CPU objective; the batch-priority mini-pass is out of scope
+    # by construction. Gate through journal_diff so direction/threshold
+    # semantics match CI ("burn_rate" is lower-is-better).
+    objective = float(os.environ.get("LOADGEN_SLO_LATENCY_S", "2.0"))
+    slo_report = obs_slo.evaluate_slos(
+        recs, slos=[obs_slo.SLO("normal", objective, 0.99, "normal")],
+    )
+    burn = obs_slo.worst_burn_rate(slo_report)
+    print(
+        f"slo: normal-class objective {objective:.2f}s target 0.99, "
+        f"worst burn rate {burn:.3f}", file=out,
+    )
+    bound = {"serve/slo/normal/burn_rate": float(
+        os.environ.get("LOADGEN_BURN_BOUND", "1.0")
+    )}
+    rows = journal_diff.compare(
+        bound, {"serve/slo/normal/burn_rate": burn}, default_threshold=0.0,
+    )
+    for r in rows:
+        if r["regression"]:
+            failures.append(
+                f"slo gate: burn rate {r['new']:.3f} over bound {r['base']:.3f}"
+            )
+    return failures
+
+
 def self_check(out=sys.stdout) -> int:
-    """CI smoke: ~200 requests on CPU, zero lost, p95 gated."""
-    from dispatches_tpu.obs.journal import Tracer, use_tracer
+    """CI smoke: ~200 requests on CPU with journey tracing, zero lost,
+    p95 + journey completeness + timeline export + SLO burn gated."""
+    from dispatches_tpu.obs.journal import Tracer, read_journal, use_tracer
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import journal_diff
@@ -242,7 +373,10 @@ def self_check(out=sys.stdout) -> int:
     ) as tr:
         report = run_service(
             requests=200, rate=400.0, bucket=8, dup_frac=0.25, seed=0,
+            reqtrace=True, detail=True,
         )
+        latencies = report.pop("latencies_by_id")
+        latencies.update(_terminal_mini_pass(out))
         tr.event("loadgen_report", **{
             k: v for k, v in report.items() if isinstance(v, (int, float))
         })
@@ -250,6 +384,7 @@ def self_check(out=sys.stdout) -> int:
 
     print(json.dumps(report, indent=2, default=str), file=out)
     failures = []
+    failures += _check_journeys(journal, latencies, out)
     if report["lost"]:
         failures.append(f"{report['lost']} lost requests")
     if report["shed"] or report["deadline_exceeded"]:
@@ -315,6 +450,15 @@ def main(argv=None) -> int:
                     help="run the one-at-a-time baseline instead")
     ap.add_argument("--json", action="store_true",
                     help="emit the raw report dict only")
+    ap.add_argument("--reqtrace", action="store_true",
+                    help="record per-request journeys and report SLO burn "
+                    "rates (journal schema v3)")
+    ap.add_argument("--journal", default=None,
+                    help="write the run journal here (implies --reqtrace)")
+    ap.add_argument("--slo-latency", type=float, default=0.25,
+                    help="SLO latency objective (s) for the burn-rate report")
+    ap.add_argument("--slo-target", type=float, default=0.99,
+                    help="SLO good-fraction target for the burn-rate report")
     ap.add_argument("--self-check", action="store_true")
     args = ap.parse_args(argv)
 
@@ -327,12 +471,33 @@ def main(argv=None) -> int:
             dup_frac=args.dup_frac, seed=args.seed,
         )
     else:
-        report = run_service(
-            requests=args.requests, rate=args.rate, bucket=args.bucket,
-            chunk_iters=args.chunk_iters, max_iter=args.max_iter,
-            queue_limit=args.queue_limit, dup_frac=args.dup_frac,
-            seed=args.seed, deadline_s=args.deadline,
-        )
+        reqtrace = args.reqtrace or bool(args.journal)
+        tracer = None
+        if reqtrace:
+            from dispatches_tpu.obs.journal import Tracer, set_tracer
+
+            tracer = Tracer(args.journal, manifest_extra={"run": "loadgen"})
+            set_tracer(tracer)
+        try:
+            report = run_service(
+                requests=args.requests, rate=args.rate, bucket=args.bucket,
+                chunk_iters=args.chunk_iters, max_iter=args.max_iter,
+                queue_limit=args.queue_limit, dup_frac=args.dup_frac,
+                seed=args.seed, deadline_s=args.deadline, reqtrace=reqtrace,
+            )
+        finally:
+            if tracer is not None:
+                from dispatches_tpu.obs.journal import set_tracer
+
+                set_tracer(None)
+                tracer.close()
+        if tracer is not None:
+            from dispatches_tpu.obs import slo as obs_slo
+
+            report["slo"] = obs_slo.evaluate_slos(
+                tracer.events,
+                slos=[obs_slo.SLO("all", args.slo_latency, args.slo_target)],
+            )
     print(json.dumps(report, indent=None if args.json else 2, default=str))
     return RC_OK
 

@@ -12,7 +12,8 @@ Request lines:
      "problem": {"A": [[...]], "b": [...], "c": [...],
                  "l": [...], "u": [...], "c0": 0.0},
      "priority": "interactive" | "normal" | "batch",   # default normal
-     "timeout": 0.5}                                    # optional, seconds
+     "timeout": 0.5,                                    # optional, seconds
+     "traceparent": "00-<32hex>-<16hex>-01"}            # optional caller ctx
     {"op": "stats"}        # service counters + latency percentiles
     {"op": "drain"}        # block until queue and slots are empty
 
@@ -20,6 +21,14 @@ Responses:
 
     {"id": "r1", "verdict": "healthy", "objective": ..., "x": [...],
      "iterations": 17, "latency_s": 0.012, "from_cache": false}
+
+With ``--reqtrace`` the service records a journey per request (journal
+schema v3; see docs/observability.md §8): a request's ``traceparent``
+field parents its journey onto the caller's span, and the response
+echoes the journey's own ``traceparent`` (plus ``parent_span_id``) so
+the caller can stitch the cross-process trace back together. A
+``DISPATCHES_TPU_TRACEPARENT`` env var likewise parents this process's
+journal manifest onto the spawning process.
 
 The service (bucket size, solver options) is built from the CLI flags at
 the FIRST solve request, using that problem's shapes; every later
@@ -57,7 +66,8 @@ def _parse_problem(spec: dict):
         raise ValueError(f"problem spec missing field {e}") from None
 
 
-def _response(result) -> dict:
+def _response(ticket) -> dict:
+    result = ticket.result(0)
     out = {
         "id": result.request_id,
         "verdict": result.verdict,
@@ -65,6 +75,10 @@ def _response(result) -> dict:
         "latency_s": result.latency,
         "iterations": result.iterations,
     }
+    journey = getattr(ticket.request, "journey", None)
+    if journey is not None:
+        out["traceparent"] = journey.ctx.to_traceparent()
+        out["parent_span_id"] = journey.ctx.parent_span_id
     sol = result.solution
     if sol is not None:
         out["objective"] = float(sol.obj)
@@ -102,7 +116,7 @@ class _Reaper:
             done = [t for t in self._pending if t.done()]
             self._pending = [t for t in self._pending if not t.done()]
         for t in done:
-            self.emit(_response(t.result(0)))
+            self.emit(_response(t))
 
     def close(self) -> None:
         while True:
@@ -127,6 +141,8 @@ def main(argv=None, out=sys.stdout) -> int:
     ap.add_argument("--cache-size", type=int, default=256)
     ap.add_argument("--journal", default=None,
                     help="write a JSONL run journal here")
+    ap.add_argument("--reqtrace", action="store_true",
+                    help="record per-request journeys (journal schema v3)")
     args = ap.parse_args(argv)
 
     import jax
@@ -160,6 +176,7 @@ def main(argv=None, out=sys.stdout) -> int:
                             max_iter=args.max_iter,
                             queue_limit=args.queue_limit,
                             cache_size=args.cache_size or None,
+                            reqtrace=args.reqtrace,
                         )
                         svc.start()
                     reaper.watch(svc.submit(
@@ -167,6 +184,7 @@ def main(argv=None, out=sys.stdout) -> int:
                         priority=req.get("priority", "normal"),
                         timeout=req.get("timeout"),
                         request_id=req.get("id"),
+                        trace_ctx=req.get("traceparent"),
                     ))
                 elif op == "stats":
                     reaper.emit(

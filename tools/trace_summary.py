@@ -18,6 +18,10 @@ manifest record). For each run this prints:
 - a run-level health footer: counts per verdict across all solve records
   (plus `hang` watchdog events and sweep point verdicts) and the worst
   offender span;
+- when the run holds schema-v3 ``journey`` records (a `reqtrace`-enabled
+  service), per-request wait/compute/transfer columns on the serve solve
+  lines and a journeys footer with terminal counts and per-priority
+  phase p95s — pre-v3 journals render exactly as before;
 - cumulative retrace counts from the close record (or summed span deltas
   for a run that died before closing).
 
@@ -159,21 +163,57 @@ def _print_spans(run: List[dict], out, max_spans: int) -> None:
         )
 
 
+def _journeys_by_request(run: List[dict]) -> dict:
+    """request_id -> schema-v3 ``journey`` record. Pre-v3 journals (and
+    runs with reqtrace off) have no journey records at all: this returns
+    {} and every caller degrades to the old rendering."""
+    out = {}
+    for ev in run:
+        if ev.get("kind") == "journey" and ev.get("request_id") is not None:
+            out[str(ev["request_id"])] = ev
+    return out
+
+
+def _fmt_phases(phases) -> str:
+    """Per-request wait/compute/transfer columns from a journey's phase
+    durations, matching the serve_*_seconds metric definitions (compute
+    includes slot admission)."""
+    if not isinstance(phases, dict):
+        return ""
+
+    def g(k):
+        v = phases.get(k)
+        return float(v) if isinstance(v, (int, float)) else None
+
+    bits = []
+    qw = g("queue_wait_s")
+    if qw is not None:
+        bits.append(f"wait={qw * 1e3:.1f}ms")
+    cs, sa = g("compute_s"), g("slot_admit_s")
+    if cs is not None or sa is not None:
+        bits.append(f"compute={((cs or 0.0) + (sa or 0.0)) * 1e3:.1f}ms")
+    hv = g("harvest_s")
+    if hv is not None:
+        bits.append(f"transfer={hv * 1e3:.1f}ms")
+    return f" [{' '.join(bits)}]" if bits else ""
+
+
 def _print_solves(run: List[dict], out) -> None:
     solves = [e for e in run if e.get("kind") == "solve"]
     if not solves:
         return
+    journeys = _journeys_by_request(run)
     print("  solves:", file=out)
     for ev in solves:
         name = ev.get("name", "?")
         try:
-            _print_one_solve(name, ev, out)
+            _print_one_solve(name, ev, out, journeys)
         except Exception as e:  # a malformed record never kills the render
             print(f"    {name}: (unrenderable solve record: "
                   f"{type(e).__name__}: {e})", file=out)
 
 
-def _print_one_solve(name: str, ev: dict, out) -> None:
+def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
     stats = ev.get("stats")
     if not isinstance(stats, dict):
         err = ev.get("stats_error", "no stats")
@@ -213,6 +253,10 @@ def _print_one_solve(name: str, ev: dict, out) -> None:
         line += f" req={ev['request_id']}"
     if isinstance(ev.get("latency_s"), (int, float)):
         line += f" latency={ev['latency_s'] * 1e3:.1f}ms"
+    if ev.get("request_id") is not None and journeys:
+        j = journeys.get(str(ev["request_id"]))
+        if isinstance(j, dict):
+            line += _fmt_phases(j.get("phases"))
     health = ev.get("health")
     if isinstance(health, dict):
         line += _fmt_verdict(health)
@@ -294,6 +338,48 @@ def _print_health_footer(run: List[dict], out) -> None:
         print(f"  worst offender: {where} ({', '.join(bits)})", file=out)
 
 
+def _print_journeys_footer(run: List[dict], out) -> None:
+    """Run-level journey aggregate: terminal counts, cross-process
+    lineage, and per-priority queue-wait / compute p95s (nearest rank).
+    Silent for pre-v3 journals — no journey records, no footer."""
+    js = [e for e in run if e.get("kind") == "journey"]
+    if not js:
+        return
+    terms: dict = {}
+    for j in js:
+        t = str(j.get("terminal") or "?")
+        terms[t] = terms.get(t, 0) + 1
+    txt = ", ".join(f"{t}={terms[t]}" for t in sorted(terms))
+    parented = sum(1 for j in js if j.get("parent_span_id"))
+    lineage = f", {parented} parented on caller spans" if parented else ""
+    print(f"  journeys: {len(js)} ({txt}){lineage}", file=out)
+
+    def p95ms(vals: list) -> str:
+        vals = sorted(vals)
+        return f"{vals[min(len(vals) - 1, int(0.95 * len(vals)))] * 1e3:.1f}ms"
+
+    by_pri: dict = {}
+    for j in js:
+        if isinstance(j.get("phases"), dict):
+            by_pri.setdefault(str(j.get("priority") or "?"), []).append(
+                j["phases"])
+    for pri in sorted(by_pri):
+        phs = by_pri[pri]
+        waits = [float(p["queue_wait_s"]) for p in phs
+                 if isinstance(p.get("queue_wait_s"), (int, float))]
+        comps = [
+            float(p.get("slot_admit_s") or 0.0) + float(p["compute_s"])
+            for p in phs if isinstance(p.get("compute_s"), (int, float))
+        ]
+        bits = []
+        if waits:
+            bits.append(f"wait p95~{p95ms(waits)}")
+        if comps:
+            bits.append(f"compute p95~{p95ms(comps)}")
+        if bits:
+            print(f"    {pri}: n={len(phs)} {' '.join(bits)}", file=out)
+
+
 def _snapshot_quantile(hist: dict, q: float):
     """Approximate q-quantile from a close-record histogram snapshot
     ({"count", "sum", "buckets": {bound_str: count}}); None when empty
@@ -353,6 +439,7 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
     _print_spans(run, out, max_spans)
     _print_solves(run, out)
     _print_health_footer(run, out)
+    _print_journeys_footer(run, out)
     close = next((e for e in run if e.get("kind") == "close"), None)
     if close is not None:
         totals = close.get("retrace_totals", {})

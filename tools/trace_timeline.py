@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Export a run journal's request journeys as Chrome trace-event JSON.
+
+Stdlib-only (like journal_diff / trace_summary): reads the schema-v3
+``journey`` records a `reqtrace`-enabled service wrote and emits the
+Trace Event Format that chrome://tracing and Perfetto load directly —
+one track (tid) per slot lane showing chunk segments, plus a queue
+track showing each request's admission-queue residency and the
+shed / deadline / cache-hit instants.
+
+Usage:
+    python tools/trace_timeline.py JOURNAL.jsonl -o timeline.trace.json
+    python tools/trace_timeline.py JOURNAL.jsonl --all-runs
+    python tools/trace_timeline.py --self-check
+
+Exit codes: 0 exported, 2 error (unreadable journal, no journey records
+— e.g. a pre-v3 journal or a service run without ``reqtrace=True``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+RC_OK, RC_ERROR = 0, 2
+
+QUEUE_TID = 0  # slot lanes are tid 1 + slot index
+_US = 1e6  # journey stamps are seconds; trace events want microseconds
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Torn-line-tolerant JSONL reader (same contract as
+    `obs.journal.read_journal`, duplicated to stay stdlib-only)."""
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def last_run(records: List[dict]) -> List[dict]:
+    """Records of the final run in a multi-run (appended) journal."""
+    starts = [i for i, r in enumerate(records) if r.get("kind") == "manifest"]
+    return records[starts[-1]:] if starts else records
+
+
+def journeys_of(records: List[dict]) -> List[dict]:
+    js = [
+        r for r in records
+        if r.get("kind") == "journey"
+        and isinstance(r.get("t0"), (int, float))
+        and isinstance(r.get("latency_s"), (int, float))
+    ]
+    return sorted(js, key=lambda r: r["t0"])
+
+
+def _meta(pid: int, tid: int, name: str, what: str) -> dict:
+    return {
+        "ph": "M", "pid": pid, "tid": tid, "name": what,
+        "args": {"name": name},
+    }
+
+
+def export_trace(records: List[dict]) -> Dict[str, Any]:
+    """Build the Chrome trace-event object for the journeys in
+    `records`. Times are shifted so the earliest submit is t=0."""
+    js = journeys_of(records)
+    pid = 1
+    events: List[dict] = [
+        _meta(pid, 0, "dispatch-service", "process_name"),
+        _meta(pid, QUEUE_TID, "queue", "thread_name"),
+    ]
+    if not js:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    origin = min(j["t0"] for j in js)
+    lanes = sorted({
+        c.get("slot") for j in js for c in j.get("chunks", [])
+        if isinstance(c.get("slot"), int)
+    })
+    for slot in lanes:
+        events.append(_meta(pid, 1 + slot, f"slot {slot}", "thread_name"))
+
+    for j in js:
+        t0 = float(j["t0"])
+        phases = j.get("phases") or {}
+        name = str(j.get("request_id") or f"seq{j.get('seq')}")
+        args = {
+            "request_id": j.get("request_id"),
+            "seq": j.get("seq"),
+            "priority": j.get("priority"),
+            "terminal": j.get("terminal"),
+            "verdict": j.get("verdict"),
+            "trace_id": j.get("trace_id"),
+            "span_id": j.get("span_id"),
+        }
+        # queue residency: starts after the admit phase, spans queue_wait
+        qw = phases.get("queue_wait_s")
+        if isinstance(qw, (int, float)) and qw >= 0:
+            qstart = t0 + float(phases.get("admit_s") or 0.0)
+            events.append({
+                "ph": "X", "pid": pid, "tid": QUEUE_TID, "cat": "queue",
+                "name": name, "ts": (qstart - origin) * _US,
+                "dur": float(qw) * _US, "args": args,
+            })
+        # chunk segments on the lane tracks
+        for c in j.get("chunks", []):
+            if not isinstance(c.get("slot"), int):
+                continue
+            events.append({
+                "ph": "X", "pid": pid, "tid": 1 + c["slot"], "cat": "chunk",
+                "name": name,
+                "ts": (t0 + float(c.get("t", 0.0)) - origin) * _US,
+                "dur": max(float(c.get("dur", 0.0)), 0.0) * _US,
+                "args": {**args, "it0": c.get("it0"), "it1": c.get("it1")},
+            })
+        # harvest transfer rides the lane track too, right after compute
+        hv = phases.get("harvest_s")
+        if isinstance(hv, (int, float)) and hv > 0 and isinstance(j.get("slot"), int):
+            off = sum(
+                float(phases.get(k) or 0.0)
+                for k in ("admit_s", "queue_wait_s", "slot_admit_s", "compute_s")
+            )
+            events.append({
+                "ph": "X", "pid": pid, "tid": 1 + j["slot"], "cat": "harvest",
+                "name": f"{name} harvest", "ts": (t0 + off - origin) * _US,
+                "dur": float(hv) * _US, "args": args,
+            })
+        # terminal instant on the queue track for non-solved endings
+        if j.get("terminal") in ("shed", "deadline_exceeded", "cache_hit"):
+            events.append({
+                "ph": "i", "pid": pid, "tid": QUEUE_TID, "s": "t",
+                "cat": "terminal", "name": f"{name} {j['terminal']}",
+                "ts": (t0 + float(j["latency_s"]) - origin) * _US,
+                "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def validate_trace(obj: Any) -> List[str]:
+    """Structural checks against the Trace Event Format; returns problem
+    strings (empty = loadable by chrome://tracing / Perfetto)."""
+    problems: List[str] = []
+    if not isinstance(obj, dict) or not isinstance(obj.get("traceEvents"), list):
+        return ["top level must be an object with a traceEvents array"]
+    for i, ev in enumerate(obj["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M", "B", "E"):
+            problems.append(f"{where}: unknown ph {ph!r}")
+            continue
+        if ph == "M":
+            if not ev.get("name"):
+                problems.append(f"{where}: metadata event without name")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            problems.append(f"{where}: missing name")
+        if not isinstance(ev.get("ts"), (int, float)) or ev["ts"] < 0:
+            problems.append(f"{where}: bad ts {ev.get('ts')!r}")
+        if not isinstance(ev.get("pid"), int) or not isinstance(ev.get("tid"), int):
+            problems.append(f"{where}: missing pid/tid")
+        if ph == "X" and (
+            not isinstance(ev.get("dur"), (int, float)) or ev["dur"] < 0
+        ):
+            problems.append(f"{where}: complete event with bad dur")
+    try:
+        json.dumps(obj)
+    except (TypeError, ValueError) as e:
+        problems.append(f"not JSON-serializable: {e}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# self check
+
+
+def _synthetic_journeys() -> List[dict]:
+    """Hand-built journeys covering every terminal (no service, no JAX)."""
+
+    def journey(rid, seq, terminal, t0, phases, chunks, slot, priority="normal"):
+        return {
+            "kind": "journey", "trace_id": "ab" * 16, "span_id": f"{seq:016x}",
+            "parent_span_id": None, "request_id": rid, "seq": seq,
+            "priority": priority, "terminal": terminal,
+            "verdict": "healthy" if terminal in ("complete", "cache_hit") else terminal,
+            "t0": t0, "latency_s": sum(phases.values()), "phases": phases,
+            "chunks": chunks, "slot": slot,
+        }
+
+    return [
+        journey(
+            "r0", 0, "complete", 10.0,
+            {"admit_s": 0.0, "queue_wait_s": 0.002, "slot_admit_s": 0.001,
+             "compute_s": 0.006, "harvest_s": 0.001, "respond_s": 0.0005},
+            [{"t": 0.003, "dur": 0.003, "it0": 0, "it1": 8, "slot": 0},
+             {"t": 0.006, "dur": 0.003, "it0": 8, "it1": 16, "slot": 0}],
+            0,
+        ),
+        journey("r1", 1, "cache_hit", 10.001, {"respond_s": 0.0002}, [], None),
+        journey(
+            "r2", 2, "shed", 10.002,
+            {"admit_s": 0.0, "queue_wait_s": 0.004, "respond_s": 0.0}, [], None,
+            priority="batch",
+        ),
+        journey(
+            "r3", 3, "deadline_exceeded", 10.003,
+            {"admit_s": 0.0, "queue_wait_s": 0.01, "respond_s": 0.001}, [], None,
+        ),
+    ]
+
+
+def self_check() -> int:
+    records = [{"kind": "manifest", "schema_version": 3}] + _synthetic_journeys()
+    trace = export_trace(records)
+    problems = validate_trace(trace)
+    evs = trace["traceEvents"]
+    kinds = {e["ph"] for e in evs}
+    checks = [
+        ("no validation problems", not problems),
+        ("has metadata events", "M" in kinds),
+        ("has complete spans", "X" in kinds),
+        ("has terminal instants", "i" in kinds),
+        ("chunk events on lane track", any(
+            e.get("cat") == "chunk" and e.get("tid") == 1 for e in evs
+        )),
+        ("queue spans on queue track", any(
+            e.get("cat") == "queue" and e.get("tid") == QUEUE_TID for e in evs
+        )),
+        ("round-trips through JSON", json.loads(json.dumps(trace)) == trace),
+        ("empty journal degrades", validate_trace(
+            export_trace([{"kind": "manifest"}])
+        ) == []),
+    ]
+    ok = True
+    for name, passed in checks:
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+        ok = ok and passed
+    if problems:
+        for p in problems[:10]:
+            print(f"    problem: {p}")
+    print(f"trace_timeline self-check: {'OK' if ok else 'FAILED'}")
+    return RC_OK if ok else RC_ERROR
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("journal", nargs="?", help="journal JSONL path")
+    ap.add_argument("-o", "--out", help="output path (default: stdout)")
+    ap.add_argument(
+        "--all-runs", action="store_true",
+        help="export every run in an appended journal (default: last run)",
+    )
+    ap.add_argument("--self-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if not args.journal:
+        ap.error("journal path required (or --self-check)")
+    try:
+        records = read_jsonl(args.journal)
+    except OSError as e:
+        print(f"error: cannot read {args.journal}: {e}", file=sys.stderr)
+        return RC_ERROR
+    if not args.all_runs:
+        records = last_run(records)
+    if not journeys_of(records):
+        print(
+            f"error: no journey records in {args.journal} (pre-v3 journal, "
+            "or the service ran without reqtrace)",
+            file=sys.stderr,
+        )
+        return RC_ERROR
+    trace = export_trace(records)
+    problems = validate_trace(trace)
+    if problems:
+        for p in problems:
+            print(f"error: {p}", file=sys.stderr)
+        return RC_ERROR
+    text = json.dumps(trace)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        n = sum(1 for e in trace["traceEvents"] if e.get("ph") != "M")
+        print(f"wrote {args.out}: {n} events")
+    else:
+        print(text)
+    return RC_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())
