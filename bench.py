@@ -555,6 +555,124 @@ def _run_year_batch_via_child(ylmp, ycf, By0, scales=None):
                 os.remove(p)
 
 
+# ----------------------------------------------------------------------
+# Probe child: the liveness probe runs in a DISPOSABLE process so a
+# wedged tunnel can be SIGKILLed per attempt. Round 5 (BENCH_r05.json
+# rc=124): the probe HUNG instead of erroring; the in-process watchdog
+# abandoned the stuck thread but could not kill it, so every retry
+# re-entered the same wedged client and the run died to the driver's
+# outer timeout with no probe record at all.
+# ----------------------------------------------------------------------
+
+def _probe_child(val_str):
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)  # match the parent's config
+    got = float(np.asarray(jnp.sqrt(jnp.asarray(float(val_str)))))
+    print(f"PROBE_OK {got!r}", flush=True)
+
+
+def _probe_via_child(probe_val, attempt_timeout_s=180.0, max_timeouts=3):
+    """Device liveness probe, hard-bounded per attempt.
+
+    Each attempt spawns ``bench.py --probe-child <val>``; on expiry
+    ``subprocess.run(timeout=...)`` SIGKILLs the child, so a hang costs
+    one attempt instead of the whole run. Retryable stderr signatures
+    walk the normal `_DELAYS` ladder; timeouts get at most
+    `max_timeouts` tries — a wedged tunnel stays wedged, and burning the
+    full ladder on it would just reproduce the rc=124 failure more
+    slowly. Exhaustion records a ``probe_timeout`` row (so the capture
+    file itself says WHY there are no numbers) and exits via `_fail`.
+    Returns the probed sqrt value on success.
+    """
+    stage = "probe"
+    timeouts = 0
+    attempts = 0
+    msg = ""
+    with _journal().span(stage, timeout_s=attempt_timeout_s):
+        for i, delay in enumerate((0,) + _DELAYS):
+            if delay:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            timed_out = False
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--probe-child", repr(probe_val)],
+                    cwd=REPO,
+                    env=dict(os.environ),
+                    timeout=attempt_timeout_s,
+                    capture_output=True,
+                    text=True,
+                )
+                rc, out_txt, err_txt = (
+                    proc.returncode, proc.stdout or "", proc.stderr or "")
+            except subprocess.TimeoutExpired:
+                timed_out, rc, out_txt = True, -9, ""
+                err_txt = f"probe child timeout {attempt_timeout_s}s (SIGKILL)"
+            attempts = i + 1
+            if rc == 0:
+                got = None
+                for line in out_txt.splitlines():
+                    if line.startswith("PROBE_OK "):
+                        got = float(line.split(None, 1)[1])
+                if got is not None and abs(got - probe_val**0.5) < 1e-5:
+                    dt = round(time.perf_counter() - t0, 3)
+                    _DIAG["stage_times"][stage] = dt
+                    _journal().metric("stage_seconds", dt, attempt=attempts)
+                    return got
+                # rc 0 with a missing/wrong value is a bench bug, not an
+                # availability problem — surface it, don't retry past it
+                _write_diag(stage, fatal_error=(
+                    f"probe child returned {got!r} for input {probe_val!r};"
+                    f" stdout tail: {out_txt[-500:]}"))
+                raise RuntimeError(f"probe child returned wrong value {got!r}")
+            msg = ("probe child timeout" if timed_out
+                   else f"probe child rc={rc}") + (
+                f": {err_txt[-2000:]}" if err_txt else "")
+            _DIAG["attempts"].append(
+                {"stage": stage, "attempt": attempts, "ts": _now(),
+                 "error": msg[:4000]}
+            )
+            _journal().event("attempt_failed", attempt=attempts,
+                             error=msg[:2000])
+            _write_diag(stage)
+            print(
+                f"bench: stage '{stage}' attempt {attempts} failed: "
+                f"{msg[:300]}",
+                file=sys.stderr,
+                flush=True,
+            )
+            if timed_out:
+                timeouts += 1
+                if timeouts >= max_timeouts:
+                    break
+                continue
+            low = err_txt.lower()
+            if any(pat in low for pat in _FATAL_FAST):
+                _write_diag(stage, fatal_error=msg[:8000])
+                _fail(stage, attempts, fatal_fast=True)
+            if not any(pat in low for pat in _RETRYABLE):
+                _write_diag(stage, fatal_error=msg[:8000])
+                raise RuntimeError(f"probe child failed: {msg[:2000]}")
+        # exhausted the ladder (or hit the timeout cap): the device never
+        # answered a scalar op — record the diagnosis as a ROW so it
+        # survives in BENCH_LOCAL.json and the journal, then fail
+        row = {
+            "attempts": attempts,
+            "timeouts": timeouts,
+            "attempt_timeout_s": attempt_timeout_s,
+            "last_error": msg[:500],
+        }
+        _LOCAL["rows"]["probe_timeout"] = row
+        _flush_local()
+        _journal().event("row", row="probe_timeout", **row)
+        _fail(stage, attempts)
+
+
 def main():
     _sweep_stale_tmps()
     # x64 on: every f32 tensor below is EXPLICIT; without this the
@@ -604,11 +722,10 @@ def main():
     # could be served from cache without touching the chip)
     seed_rng = np.random.default_rng(time.time_ns() % (2**32))
     probe_val = float(seed_rng.uniform(1.0, 2.0))
-    got = _device(
-        "probe",
-        lambda: float(np.asarray(jnp.sqrt(jnp.asarray(probe_val)))),
-        timeout_s=180.0,  # a scalar op; minutes mean the tunnel is wedged
-    )
+    # the probe runs in a disposable CHILD with a per-attempt hard
+    # timeout (SIGKILL): a wedged tunnel costs one bounded attempt, not
+    # the whole run (round 5: the in-process probe hung to rc=124)
+    got = _probe_via_child(probe_val, attempt_timeout_s=180.0)
     assert abs(got - probe_val**0.5) < 1e-5
     _DIAG["devices"] = [str(d) for d in jax.devices()]
     _LOCAL["devices"] = _DIAG["devices"]
@@ -1257,6 +1374,8 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--year-batch-child":
         _year_batch_child(sys.argv[2], int(sys.argv[3]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--probe-child":
+        _probe_child(sys.argv[2])
     else:
         # the close record (cumulative retrace counts) must land on every
         # exit path — gate sys.exit(1)s and _fail included

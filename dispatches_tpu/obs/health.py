@@ -63,7 +63,7 @@ CYCLE_AMP = 0.10  # cycling: minimum relative amplitude (flat != cycling)
 # but-ugly trajectory, better than a solver breakdown.
 SEVERITY = (
     "healthy", "slow", "cycling", "stalled",
-    "deadline_exceeded", "shed",
+    "deadline_exceeded", "shed", "shed_tenant_quota",
     "diverged", "nonfinite", "hang", "failed",
 )
 

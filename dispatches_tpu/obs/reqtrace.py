@@ -153,7 +153,7 @@ class Journey:
 
     __slots__ = (
         "ctx", "request_id", "seq", "priority", "clock", "t0",
-        "marks", "chunks", "slot", "terminal",
+        "marks", "chunks", "slot", "shard", "terminal",
     )
 
     def __init__(
@@ -175,6 +175,7 @@ class Journey:
         self.marks: Dict[str, float] = {}
         self.chunks: List[Dict[str, Any]] = []
         self.slot: Optional[int] = None
+        self.shard: Optional[int] = None  # fleet-served requests only
         self.terminal: Optional[str] = None
 
     def mark(self, name: str, t: Optional[float] = None) -> None:
@@ -183,12 +184,21 @@ class Journey:
         if name not in self.marks:
             self.marks[name] = self.clock() if t is None else float(t)
 
-    def note_chunk(self, t0: float, t1: float, it0: int, it1: int, slot: int) -> None:
-        """Record one engine chunk segment this request participated in."""
-        self.chunks.append({
+    def note_chunk(
+        self, t0: float, t1: float, it0: int, it1: int, slot: int,
+        shard: Optional[int] = None,
+    ) -> None:
+        """Record one engine chunk segment this request participated in.
+        `shard` names the fleet shard whose engine ran the segment (None
+        for the in-process single-engine service)."""
+        seg = {
             "t": float(t0), "t1": float(t1),
             "it0": int(it0), "it1": int(it1), "slot": int(slot),
-        })
+        }
+        if shard is not None:
+            seg["shard"] = int(shard)
+            self.shard = int(shard)
+        self.chunks.append(seg)
         self.slot = int(slot)
 
     def phase_durations(self, responded: float) -> Dict[str, float]:
@@ -244,10 +254,12 @@ class Journey:
                 {
                     "t": c["t"] - self.t0, "dur": c["t1"] - c["t"],
                     "it0": c["it0"], "it1": c["it1"], "slot": c["slot"],
+                    **({"shard": c["shard"]} if "shard" in c else {}),
                 }
                 for c in self.chunks
             ],
             "slot": self.slot,
+            "shard": self.shard,
         }
         rec.update(extra)
         from .journal import get_tracer  # lazy: journal imports us for the manifest

@@ -62,6 +62,24 @@ def scenario_mesh(n_devices: Optional[int] = None, axis: str = "scenario") -> Me
     return Mesh(np.asarray(devs), (axis,))
 
 
+def shard_device_env(n_shards: int) -> list:
+    """Per-shard child environments for the serving fleet: when this host
+    exposes at least `n_shards` devices, shard i pins its child process to
+    device i (`serve.shard.DEVICE_ENV`); otherwise every shard shares the
+    default device and isolation is purely process-level. Env vars rather
+    than in-child mesh logic so the parent decides placement and the child
+    stays a dumb crash domain."""
+    from ..serve.shard import DEVICE_ENV
+
+    try:
+        n_dev = len(jax.devices())
+    except Exception:
+        n_dev = 1
+    if n_shards > 1 and n_dev >= n_shards:
+        return [{DEVICE_ENV: str(i)} for i in range(n_shards)]
+    return [{} for _ in range(n_shards)]
+
+
 def solve_lp_sharded(
     lp: LPData,
     mesh: Mesh,

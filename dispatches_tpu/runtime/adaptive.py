@@ -686,6 +686,33 @@ class SlotEngine:
         return out
 
 
+def make_dense_engine(
+    bucket: int,
+    *,
+    chunk_iters: int = 8,
+    trace: bool = False,
+    **solver_kw,
+) -> "SlotEngine":
+    """One dense-LP `SlotEngine` at `bucket` lanes — the construction
+    shared by the in-process service (`serve.service.make_dense_service`)
+    and the fleet's shard child (`serve.shard`), so both paths compile
+    identical cold/resume executables and the bitwise contract holds
+    across the process boundary. `solver_kw` flows to `solve_lp_partial`
+    (`max_iter` also bounds the engine's per-lane budget)."""
+    from ..core.program import LPData
+
+    solver_kw.setdefault("max_iter", 60)
+    d_axes = LPData(*(0,) * len(LPData._fields))
+    seg_cold, seg_resume = dense_segments(
+        d_axes, None, trace, solver_kw, stop_axis=0
+    )
+    return SlotEngine(
+        "serve_dense", LPData, seg_cold, seg_resume, bucket,
+        chunk_iters=chunk_iters, max_iter=solver_kw["max_iter"],
+        trace=trace, opt_key=_opt_key(solver_kw),
+    )
+
+
 # ---------------------------------------------------------------------------
 # entry points
 

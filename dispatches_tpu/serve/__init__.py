@@ -1,4 +1,4 @@
-"""In-process asynchronous dispatch service (continuous batching).
+"""Asynchronous dispatch serving: single-engine service + sharded fleet.
 
 Accepts solve requests — a `CompiledLP` + params or a prebuilt problem
 row — queues them with priority classes and per-request deadlines, and
@@ -8,11 +8,23 @@ the device executables stay hot under sustained load. Admission control
 sheds lowest-priority work when the bounded queue overflows; deadline
 enforcement returns the best iterate so far with a
 ``deadline_exceeded`` verdict; a fingerprint-keyed LRU cache returns
-previously solved answers bitwise. See `docs/serving.md`.
+previously solved answers bitwise.
+
+Two deployment shapes share the ticket contract:
+
+- `DispatchService` / `make_dense_service` — one in-process engine.
+- `FleetService` / `make_dense_fleet` — N shard child processes, each a
+  crash domain (`serve.shard`), balanced by `serve.router.Router`, with
+  per-tenant fairness and rate limits (`serve.queue.FairQueue`), shard
+  respawn with bounded backoff, and automatic requeue of a crashed
+  shard's in-flight lanes.
+
+See `docs/serving.md`.
 """
 
 from .cache import ResultCache
-from .queue import AdmissionQueue
+from .fleet import FleetService, make_dense_fleet
+from .queue import AdmissionQueue, FairQueue, TenantConfig, TokenBucket
 from .request import (
     PRIORITY_CLASSES,
     SolveRequest,
@@ -21,16 +33,25 @@ from .request import (
     priority_name,
     priority_value,
 )
+from .router import Router
 from .service import DispatchService, make_dense_service
+from .shard import ShardProcess
 
 __all__ = [
     "AdmissionQueue",
     "DispatchService",
+    "FairQueue",
+    "FleetService",
     "PRIORITY_CLASSES",
     "ResultCache",
+    "Router",
+    "ShardProcess",
     "SolveRequest",
     "SolveResult",
+    "TenantConfig",
     "Ticket",
+    "TokenBucket",
+    "make_dense_fleet",
     "make_dense_service",
     "priority_name",
     "priority_value",

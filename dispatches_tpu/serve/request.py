@@ -67,6 +67,7 @@ class SolveRequest:
     __slots__ = (
         "problem", "priority", "deadline", "fingerprint", "request_id",
         "seq", "submitted_at", "started_at", "ticket", "journey",
+        "tenant", "requeues",
     )
 
     def __init__(
@@ -77,12 +78,14 @@ class SolveRequest:
         deadline: Optional[float] = None,
         fingerprint: Optional[str] = None,
         request_id: Optional[str] = None,
+        tenant: str = "default",
     ):
         self.problem = problem
         self.priority = int(priority)
         self.deadline = deadline
         self.fingerprint = fingerprint
         self.request_id = request_id
+        self.tenant = str(tenant)
         self.seq: int = -1  # assigned by the service at submit
         self.submitted_at: Optional[float] = None
         self.started_at: Optional[float] = None
@@ -90,6 +93,10 @@ class SolveRequest:
         # obs.reqtrace.Journey when the service runs with reqtrace=True;
         # None otherwise (the off path never touches it)
         self.journey: Optional[Any] = None
+        # times a crashed/wedged shard handed this request back to the
+        # queue (fleet bookkeeping; a requeued lane re-solves from
+        # iteration 0, so its result stays bitwise-identical)
+        self.requeues: int = 0
 
     def sort_key(self):
         # FIFO within a priority class; seq is service-assigned and unique

@@ -1,0 +1,71 @@
+"""Shard selection for the serving fleet.
+
+The router answers one question per dispatch: which up shard gets this
+request? Three signals, in order of force:
+
+1. **Capacity** — only shards with a free lane (in-flight < bucket) are
+   candidates; the fleet holds the request queued otherwise.
+2. **Priority class** — interactive requests always go to the
+   least-loaded candidate: latency work buys the shortest line, never a
+   warm cache.
+3. **Bucket affinity** — other classes prefer the shard that last
+   solved this fingerprint (its executables and result paths are warm),
+   unless that shard's queue depth exceeds the least-loaded candidate
+   by more than `affinity_slack` lanes — affinity is a tiebreak, not a
+   hotspot generator.
+
+Ties break round-robin so identical shards share load instead of
+convoying onto shard 0. The affinity table is a bounded LRU; a crashed
+shard's entries are dropped by the fleet on respawn (a fresh process
+has nothing warm)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, List, Optional
+
+
+class Router:
+    def __init__(self, *, affinity_capacity: int = 1024,
+                 affinity_slack: int = 2):
+        self.affinity_capacity = int(affinity_capacity)
+        self.affinity_slack = int(affinity_slack)
+        self._aff: "OrderedDict[str, int]" = OrderedDict()
+        self._rr = 0
+
+    def pick(self, req, shards: List[Any]) -> Optional[Any]:
+        """Choose a shard for `req` from `shards` (the fleet passes only
+        up shards). Returns None when every shard is at capacity."""
+        free = [s for s in shards if s.inflight() < s.bucket]
+        if not free:
+            return None
+        self._rr += 1
+        least = min(
+            free,
+            key=lambda s: (s.inflight(), (s.shard_id - self._rr) % 997),
+        )
+        if req.priority <= 0 or req.fingerprint is None:
+            return least
+        aff_id = self._aff.get(req.fingerprint)
+        if aff_id is not None:
+            for s in free:
+                if s.shard_id == aff_id:
+                    if s.inflight() <= least.inflight() + self.affinity_slack:
+                        return s
+                    break
+        return least
+
+    def note_dispatch(self, req, shard) -> None:
+        """Record where a fingerprint landed (LRU, bounded)."""
+        if req.fingerprint is None:
+            return
+        self._aff.pop(req.fingerprint, None)
+        self._aff[req.fingerprint] = shard.shard_id
+        while len(self._aff) > self.affinity_capacity:
+            self._aff.popitem(last=False)
+
+    def forget_shard(self, shard_id: int) -> None:
+        """Drop every affinity entry for a crashed shard — its respawned
+        process has nothing warm to prefer."""
+        stale = [fp for fp, sid in self._aff.items() if sid == shard_id]
+        for fp in stale:
+            del self._aff[fp]

@@ -250,14 +250,48 @@ class DispatchService:
             )
         return done
 
-    def drain(self, max_cycles: int = 10_000) -> int:
-        """Pump until queue and slots are empty; returns completions."""
+    def drain(
+        self, max_cycles: int = 10_000, timeout: Optional[float] = None
+    ) -> int:
+        """Pump until queue and slots are empty; returns completions.
+
+        With `timeout` (seconds on the service clock), a drain that has
+        not converged by the deadline stops pumping and resolves every
+        still-queued ticket as ``shed`` (journaled with
+        ``detail="drain_timeout"``) instead of blocking forever — the
+        shutdown path when the engine is wedged. In-flight lanes are
+        evicted with their best iterate as ``deadline_exceeded``."""
+        t0 = self.clock()
         total = 0
         for _ in range(max_cycles):
             if not len(self.queue) and not self.engine.active():
                 return total
+            if timeout is not None and self.clock() - t0 >= timeout:
+                return total + self._drain_expire()
             total += self.pump()
         raise RuntimeError(f"drain did not converge in {max_cycles} cycles")
+
+    def _drain_expire(self) -> int:
+        """Shed everything still queued and evict everything in flight
+        (best iterate, ``deadline_exceeded``) — the drain-timeout path."""
+        done = 0
+        with self._lock:
+            for req in self.queue.pop_all():
+                if req.journey is not None:
+                    req.journey.mark("dequeued")
+                self._resolve_shed(req, detail="drain_timeout")
+                done += 1
+            for req in list(self.engine.active()):
+                row = self.engine.evict(req)
+                if req.journey is not None and row is not None:
+                    req.journey.mark("harvest_end")
+                self._resolve_deadline(
+                    req, solution=row,
+                    iterations=None if row is None else int(row.iterations),
+                )
+                done += 1
+            obs_metrics.set_gauge("serve_queue_depth", len(self.queue))
+        return done
 
     # -- background mode -----------------------------------------------
     def start(self, idle_sleep: float = 0.001) -> None:
@@ -389,16 +423,18 @@ class DispatchService:
             request_id=req.request_id,
         ))
 
-    def _resolve_shed(self, req) -> None:
+    def _resolve_shed(self, req, detail: Optional[str] = None) -> None:
         self.completed += 1
         self.shed_total += 1
         now = self.clock()
         latency = now - req.submitted_at
         obs_metrics.inc("serve_requests_total", status="shed")
         obs_metrics.inc("serve_shed_total")
+        extra = {} if detail is None else {"detail": detail}
         get_tracer().event(
             "serve_shed", verdict="shed",
             request_id=req.request_id, seq=req.seq, priority=req.priority,
+            **extra,
         )
         obs_health.note_verdicts({"shed": 1}, solve=self.name)
         if req.journey is not None:
@@ -454,18 +490,10 @@ def make_dense_service(
     one `SlotEngine` at `bucket` lanes, solver options passed through to
     `solve_lp_partial` (`max_iter` also bounds the engine's per-lane
     budget). Every submitted row must share shapes (M, N)."""
-    from ..core.program import LPData
-    from ..runtime.adaptive import SlotEngine, _opt_key, dense_segments
+    from ..runtime.adaptive import make_dense_engine
 
-    solver_kw.setdefault("max_iter", 60)
-    d_axes = LPData(*(0,) * len(LPData._fields))
-    seg_cold, seg_resume = dense_segments(
-        d_axes, None, trace, solver_kw, stop_axis=0
-    )
-    engine = SlotEngine(
-        "serve_dense", LPData, seg_cold, seg_resume, bucket,
-        chunk_iters=chunk_iters, max_iter=solver_kw["max_iter"],
-        trace=trace, opt_key=_opt_key(solver_kw),
+    engine = make_dense_engine(
+        bucket, chunk_iters=chunk_iters, trace=trace, **solver_kw
     )
     cache = ResultCache(cache_size) if cache_size else None
     return DispatchService(

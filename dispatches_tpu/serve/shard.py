@@ -1,0 +1,522 @@
+"""Crash-domain shard: a `SlotEngine` in its own child process.
+
+The serving tier's fault-isolation unit. `bench.py --year-batch-child`
+proved the pattern on the TPU tunnel: a worker crash poisons the parent
+PJRT client, and only a fresh process recovers — so the fleet
+(`serve/fleet.py`) runs every engine behind a process boundary. This
+module is both halves of that boundary:
+
+- the CHILD (spawned through `_BOOTSTRAP`, which loads this file by path
+  so nothing jax-heavy imports first; ``python -m
+  dispatches_tpu.serve.shard`` also works by hand): builds one dense
+  `SlotEngine` via `runtime.adaptive.make_dense_engine` (identical
+  executables to the in-process service, so the bitwise contract holds
+  across the pipe) and speaks the frame protocol below over
+  stdin/stdout. A reader thread answers heartbeat pings immediately —
+  from milliseconds after spawn, through jax import and compile — so
+  supervision distinguishes "busy" from "wedged".
+- the PARENT handle (`ShardProcess`): spawn/kill lifecycle, non-blocking
+  result polling, heartbeat bookkeeping, and the in-flight lane map the
+  fleet requeues from when the child dies.
+
+Wire protocol: length-prefixed JSON frames — an ASCII decimal byte
+count, ``\\n``, then exactly that many bytes of UTF-8 JSON. Length
+prefixes (not bare JSONL) because frames embed base64 array payloads
+that routinely exceed pipe atomicity, and a torn frame must fail the
+read, not desynchronize the stream. Arrays travel as raw little-endian
+bytes (base64) + dtype + shape, so a problem row and its solution
+round-trip BITWISE — float repr would quietly break the identity
+contract the whole serving tier is tested against.
+
+Frames parent -> child::
+
+    {"op": "ping", "seq": n}
+    {"op": "solve", "lane": id, "problem": <row>}
+    {"op": "cancel", "lane": id}
+    {"op": "fault", "mode": "exit" | "hang" | "nan"}   # test/chaos hook
+    {"op": "shutdown"}
+
+Frames child -> parent::
+
+    {"op": "pong", "seq": n}
+    {"op": "result", "lane": id, "slot": s, "iterations": k,
+     "row": <row>}
+
+The ``fault`` op is the fault-injection surface `tests/test_serve_fleet.py`
+and the loadgen chaos leg drive: ``exit`` dies immediately (os._exit),
+``hang`` wedges the child (no pongs, no results, process stays alive —
+the heartbeat-timeout path), ``nan`` poisons subsequent solution rows
+with NaNs (the nonfinite-verdict path). `DIE_ON_START_ENV` makes a
+freshly spawned child exit before serving anything — the
+respawn-backoff test knob.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from queue import Empty, Queue
+from typing import Any, Dict, IO, List, Optional, Tuple
+
+#: child exits immediately at startup when this env var is "1"
+#: (fleet respawn-backoff tests; cleared by the fleet on respawn unless
+#: the test keeps injecting it)
+DIE_ON_START_ENV = "DISPATCHES_TPU_SHARD_DIE_ON_START"
+#: pins the child's default jax device to this index (fleet sets it from
+#: `parallel.mesh.shard_device_env` so shards spread over the mesh)
+DEVICE_ENV = "DISPATCHES_TPU_SHARD_DEVICE"
+
+_MAX_FRAME = 256 * 1024 * 1024  # refuse absurd lengths: torn stream, not data
+
+#: child bootstrap: load THIS file as a standalone module (stdlib-only
+#: top level) instead of ``-m dispatches_tpu.serve.shard`` — the ``-m``
+#: path imports the package __init__ (and with it jax) BEFORE
+#: worker_main can start its ping-answering reader thread, so a fleet
+#: running a sub-second heartbeat timeout would declare every freshly
+#: respawned child wedged mid-import. The bootstrap gets the reader up
+#: within milliseconds; jax imports after, under heartbeat cover.
+_BOOTSTRAP = (
+    "import importlib.util, sys; "
+    "spec = importlib.util.spec_from_file_location('dispatches_tpu_shard_child', sys.argv[1]); "
+    "mod = importlib.util.module_from_spec(spec); "
+    "spec.loader.exec_module(mod); "
+    "sys.exit(mod.worker_main(sys.argv[2:]))"
+)
+
+
+# ---------------------------------------------------------------------------
+# framing + array codec
+
+
+def write_frame(fh: IO[bytes], obj: dict) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    fh.write(b"%d\n" % len(payload))
+    fh.write(payload)
+    fh.flush()
+
+
+def read_frame(fh: IO[bytes]) -> Optional[dict]:
+    """One frame, or None on EOF / torn stream (callers treat both as
+    the peer going away)."""
+    header = fh.readline()
+    if not header:
+        return None
+    try:
+        n = int(header)
+    except ValueError:
+        return None
+    if n < 0 or n > _MAX_FRAME:
+        return None
+    payload = fh.read(n)
+    if payload is None or len(payload) < n:
+        return None
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+def encode_array(a) -> dict:
+    import numpy as np
+
+    a = np.asarray(a)
+    shape = list(a.shape)  # BEFORE ascontiguousarray: it promotes 0-d to 1-d
+    a = np.ascontiguousarray(a)
+    return {
+        "dtype": a.dtype.str,  # byte-order-qualified: '<f8', not 'float64'
+        "shape": shape,
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def decode_array(spec: dict):
+    import numpy as np
+
+    a = np.frombuffer(
+        base64.b64decode(spec["b64"]), dtype=np.dtype(spec["dtype"])
+    )
+    return a.reshape(tuple(spec["shape"]))
+
+
+def encode_row(row) -> dict:
+    """A problem/solution NamedTuple with array leaves -> one frame-able
+    dict (class name + ordered field names + encoded leaves)."""
+    return {
+        "cls": type(row).__name__,
+        "names": list(row._fields),
+        "leaves": [encode_array(leaf) for leaf in row],
+    }
+
+
+def _row_cls(name: str, fields: Tuple[str, ...]):
+    """Resolve a row class by name; unknown names degrade to an ad-hoc
+    namedtuple with the sender's field order (the fleet only reads
+    fields by name, so results stay usable)."""
+    # absolute imports: this module also runs standalone in the child
+    # (loaded by file path via _BOOTSTRAP, outside the package)
+    if name == "LPData":
+        from dispatches_tpu.core.program import LPData
+
+        if LPData._fields == fields:
+            return LPData
+    if name == "IPMSolution":
+        from dispatches_tpu.solvers.ipm import IPMSolution
+
+        if IPMSolution._fields == fields:
+            return IPMSolution
+    import collections
+
+    return collections.namedtuple(name, fields)
+
+
+def decode_row(spec: dict):
+    fields = tuple(spec["names"])
+    cls = _row_cls(spec["cls"], fields)
+    return cls(*(decode_array(leaf) for leaf in spec["leaves"]))
+
+
+# ---------------------------------------------------------------------------
+# the child worker
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    """Entry point of ``python -m dispatches_tpu.serve.shard``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="dispatches_tpu.serve.shard")
+    ap.add_argument("--bucket", type=int, required=True)
+    ap.add_argument("--chunk-iters", type=int, default=8)
+    ap.add_argument("--shard-id", type=int, default=0)
+    ap.add_argument("--x64", type=int, default=1)
+    ap.add_argument("--solver-kw", default="{}",
+                    help="JSON dict forwarded to solve_lp_partial")
+    args = ap.parse_args(argv)
+
+    if os.environ.get(DIE_ON_START_ENV) == "1":
+        return 3
+
+    inp = sys.stdin.buffer
+    outp = sys.stdout.buffer
+    # stray prints (library warnings, debuggers) must not corrupt the
+    # frame stream: from here on, "stdout" is stderr
+    sys.stdout = sys.stderr
+
+    out_lock = threading.Lock()
+    inbox: Queue = Queue()
+    fault = {"hang": False, "nan": False}
+
+    def _send(obj: dict) -> None:
+        with out_lock:
+            write_frame(outp, obj)
+
+    def _reader() -> None:
+        # pings answered HERE, synchronously, before any jax import or
+        # compile finishes — a busy shard heartbeats, a wedged one doesn't
+        while True:
+            msg = read_frame(inp)
+            if msg is None:
+                inbox.put(None)
+                return
+            op = msg.get("op")
+            if op == "ping":
+                if not fault["hang"]:
+                    _send({"op": "pong", "seq": msg.get("seq")})
+            elif op == "fault":
+                mode = msg.get("mode")
+                if mode == "exit":
+                    os._exit(13)
+                elif mode in fault:
+                    fault[mode] = True
+            else:
+                inbox.put(msg)
+
+    threading.Thread(target=_reader, name="shard-reader", daemon=True).start()
+
+    import jax
+
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+    dev = os.environ.get(DEVICE_ENV)
+    if dev is not None:
+        devices = jax.devices()
+        jax.config.update(
+            "jax_default_device", devices[int(dev) % len(devices)]
+        )
+    import numpy as np
+
+    from dispatches_tpu.runtime.adaptive import make_dense_engine
+
+    solver_kw = json.loads(args.solver_kw)
+    engine = make_dense_engine(
+        args.bucket, chunk_iters=args.chunk_iters, **solver_kw
+    )
+
+    pending: List[dict] = []
+    slots: Dict[Any, int] = {}  # lane id -> engine slot, for result frames
+    while True:
+        if fault["hang"]:
+            # wedged on purpose: alive, silent — the parent's heartbeat
+            # timeout is the only way out
+            time.sleep(0.05)
+            continue
+        busy = bool(pending) or bool(engine.active())
+        drained: List[Optional[dict]] = []
+        if busy:
+            while True:
+                try:
+                    drained.append(inbox.get_nowait())
+                except Empty:
+                    break
+        else:
+            drained.append(inbox.get())  # idle: block for work
+        stop = False
+        for msg in drained:
+            if msg is None or msg.get("op") == "shutdown":
+                stop = True
+                break
+            op = msg.get("op")
+            if op == "solve":
+                pending.append(msg)
+            elif op == "cancel":
+                # fully handled here: the lane leaves pending/engine, so
+                # no result frame can be emitted for it afterwards (a
+                # result already in flight resolves first at the parent's
+                # one-shot ticket and this cancel is a no-op there)
+                lane = msg.get("lane")
+                pending = [m for m in pending if m.get("lane") != lane]
+                slots.pop(lane, None)
+                if lane in engine.active():
+                    engine.evict(lane)
+        if stop:
+            return 0
+        while pending and engine.free_slots():
+            msg = pending.pop(0)
+            row = decode_row(msg["problem"])
+            slots[msg["lane"]] = engine.admit(msg["lane"], row)
+        for lane, row, stats in engine.step() if engine.active() else ():
+            slot = slots.pop(lane, -1)
+            if fault["nan"]:
+                row = type(row)(*(
+                    np.full_like(leaf, np.nan)
+                    if np.asarray(leaf).dtype.kind == "f" else leaf
+                    for leaf in row
+                ))
+            _send({
+                "op": "result",
+                "lane": lane,
+                "slot": slot,
+                "iterations": stats.get("iterations"),
+                "row": encode_row(row),
+            })
+
+
+# ---------------------------------------------------------------------------
+# the parent-side handle
+
+
+class ShardProcess:
+    """One crash domain, as the fleet sees it.
+
+    Owns the child's lifecycle (spawn/kill), the write side of the pipe,
+    a reader thread draining results, heartbeat stamps on the REAL clock
+    (`time.monotonic` — liveness is wall-clock even when the service
+    runs a fake clock), and the ``lanes`` map (lane id -> SolveRequest)
+    the fleet requeues from on failure. Not thread-safe beyond the
+    reader/send split; the fleet calls everything else under its lock.
+    """
+
+    def __init__(
+        self,
+        shard_id: int,
+        *,
+        bucket: int,
+        chunk_iters: int = 8,
+        solver_kw: Optional[dict] = None,
+        device_env: Optional[Dict[str, str]] = None,
+        extra_env: Optional[Dict[str, str]] = None,
+        stderr_path: Optional[str] = None,
+    ):
+        self.shard_id = int(shard_id)
+        self.bucket = int(bucket)
+        self.chunk_iters = int(chunk_iters)
+        self.solver_kw = dict(solver_kw or {})
+        self.device_env = dict(device_env or {})
+        self.extra_env = dict(extra_env or {})
+        self.stderr_path = stderr_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.lanes: Dict[Any, Any] = {}  # lane id -> SolveRequest
+        self.last_ping: Optional[float] = None
+        self.last_pong: float = 0.0
+        self.spawned_at: float = 0.0
+        self.spawn_count = 0
+        self._results: Queue = Queue()
+        self._eof = False
+        self._send_lock = threading.Lock()
+        self._ping_seq = 0
+        self._stderr_fh = None
+
+    # -- lifecycle -----------------------------------------------------
+    def spawn(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            raise RuntimeError(f"shard {self.shard_id} already running")
+        import jax
+
+        # _BOOTSTRAP, not ``-m dispatches_tpu.serve.shard``: -m runs the
+        # package __init__ (jax import, seconds) before worker_main can
+        # answer pings, so a respawn under a tight heartbeat_timeout
+        # would be killed as wedged before it ever speaks
+        cmd = [
+            sys.executable, "-c", _BOOTSTRAP, os.path.abspath(__file__),
+            "--bucket", str(self.bucket),
+            "--chunk-iters", str(self.chunk_iters),
+            "--shard-id", str(self.shard_id),
+            "--x64", "1" if jax.config.jax_enable_x64 else "0",
+            "--solver-kw", json.dumps(self.solver_kw),
+        ]
+        env = dict(os.environ)
+        # the child must import dispatches_tpu no matter the parent's cwd
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(self.device_env)
+        env.update(self.extra_env)
+        stderr = subprocess.DEVNULL
+        if self.stderr_path:
+            self._stderr_fh = open(self.stderr_path, "ab")
+            stderr = self._stderr_fh
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=stderr, env=env,
+        )
+        self.spawn_count += 1
+        self._eof = False
+        self._results = Queue()
+        now = time.monotonic()
+        self.spawned_at = now
+        self.last_ping = None
+        self.last_pong = now  # spawn grace: no wedge verdict before a ping
+        threading.Thread(
+            target=self._reader, args=(self.proc, self._results),
+            name=f"shard-{self.shard_id}-reader", daemon=True,
+        ).start()
+
+    def _reader(self, proc: subprocess.Popen, results: Queue) -> None:
+        while True:
+            msg = read_frame(proc.stdout)
+            if msg is None:
+                if proc is self.proc:
+                    self._eof = True
+                return
+            if msg.get("op") == "pong":
+                if proc is self.proc:
+                    self.last_pong = time.monotonic()
+            else:
+                results.put(msg)
+
+    def kill(self) -> None:
+        """SIGKILL + reap. Idempotent; never raises on an already-dead
+        child."""
+        proc, self.proc = self.proc, None
+        if proc is not None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=10.0)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+            for fh in (proc.stdin, proc.stdout):
+                try:
+                    if fh is not None:
+                        fh.close()
+                except OSError:
+                    pass
+        if self._stderr_fh is not None:
+            try:
+                self._stderr_fh.close()
+            except OSError:
+                pass
+            self._stderr_fh = None
+
+    # -- protocol ------------------------------------------------------
+    def _send(self, obj: dict) -> bool:
+        proc = self.proc
+        if proc is None or proc.stdin is None:
+            return False
+        try:
+            with self._send_lock:
+                write_frame(proc.stdin, obj)
+            return True
+        except (OSError, ValueError):  # broken pipe / closed file
+            return False
+
+    def solve(self, lane, req) -> bool:
+        """Dispatch one request; tracks it in `lanes` until a result
+        arrives or the fleet requeues it. Returns False (without
+        tracking) when the pipe is already dead."""
+        ok = self._send({
+            "op": "solve", "lane": lane, "problem": encode_row(req.problem),
+        })
+        if ok:
+            self.lanes[lane] = req
+        return ok
+
+    def cancel(self, lane) -> None:
+        self.lanes.pop(lane, None)
+        self._send({"op": "cancel", "lane": lane})
+
+    def inject_fault(self, mode: str) -> bool:
+        """Chaos hook: forward a fault op (``exit``/``hang``/``nan``)."""
+        return self._send({"op": "fault", "mode": mode})
+
+    def ping(self) -> None:
+        self._ping_seq += 1
+        # stamp BEFORE the send: a fast child's pong can land (and stamp
+        # last_pong) before a post-send stamp would run, leaving
+        # last_pong < last_ping forever — supervision then never re-pings
+        # and kills a healthy shard when the wedge timer expires
+        stamp = time.monotonic()
+        if self._send({"op": "ping", "seq": self._ping_seq}):
+            self.last_ping = stamp
+
+    def poll(self) -> List[dict]:
+        """Drain every result frame received so far (non-blocking)."""
+        out: List[dict] = []
+        while True:
+            try:
+                out.append(self._results.get_nowait())
+            except Empty:
+                return out
+
+    # -- liveness ------------------------------------------------------
+    def alive(self) -> bool:
+        return (
+            self.proc is not None
+            and self.proc.poll() is None
+            and not self._eof
+        )
+
+    def exit_code(self) -> Optional[int]:
+        return None if self.proc is None else self.proc.poll()
+
+    def wedged(self, heartbeat_timeout: float) -> bool:
+        """True when a ping has gone unanswered past the timeout — the
+        process is alive but the protocol loop is not (hang fault, stuck
+        device call). A shard that was never pinged is never wedged."""
+        if self.last_ping is None or self.last_pong >= self.last_ping:
+            return False
+        return time.monotonic() - self.last_ping > heartbeat_timeout
+
+    def inflight(self) -> int:
+        return len(self.lanes)
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

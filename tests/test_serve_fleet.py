@@ -1,0 +1,458 @@
+"""Fleet subsystem: per-tenant fairness (weighted DRR + token buckets),
+shard routing, fake-clock fleet semantics against stub shards, and the
+crash-domain contracts against REAL shard children — respawn with
+backoff, in-flight requeue, zero lost tickets, and bitwise identity with
+the single-engine service. Child-spawning tests are kept few and small:
+each one pays a subprocess jax import."""
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.serve import (
+    FairQueue,
+    FleetService,
+    Router,
+    ShardProcess,
+    SolveRequest,
+    TenantConfig,
+    TokenBucket,
+    make_dense_fleet,
+    make_dense_service,
+)
+from dispatches_tpu.serve.shard import DIE_ON_START_ENV
+
+
+def _lp(seed, n=6, m=3, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    return LPData(
+        jnp.asarray(A, dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _req(seq, priority=1, tenant="default", deadline=None, fingerprint=None):
+    r = SolveRequest(
+        None, priority=priority, tenant=tenant, deadline=deadline,
+        fingerprint=fingerprint,
+    )
+    r.seq = seq
+    return r
+
+
+# ---------------------------------------------------------------------
+# token bucket + fair queue (pure host logic, fake time)
+# ---------------------------------------------------------------------
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        b = TokenBucket(rate=1.0, burst=2.0)
+        assert b.allow(0.0)
+        assert b.allow(0.0)
+        assert not b.allow(0.0)  # burst exhausted
+        assert b.allow(1.0)  # one token refilled after 1 s
+        assert not b.allow(1.0)
+
+    def test_refill_caps_at_burst(self):
+        b = TokenBucket(rate=10.0, burst=2.0)
+        assert b.allow(0.0)
+        # a long idle period may refill at most `burst` tokens
+        assert b.allow(100.0)
+        assert b.allow(100.0)
+        assert not b.allow(100.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0.0)
+        with pytest.raises(ValueError):
+            TokenBucket(rate=1.0, burst=-1.0)
+
+
+class TestFairQueue:
+    def test_weighted_drr_share(self):
+        q = FairQueue(64, tenants={
+            "a": TenantConfig(weight=2.0), "b": TenantConfig(weight=1.0),
+        })
+        for i in range(6):
+            q.push(_req(i, tenant="a"))
+            q.push(_req(100 + i, tenant="b"))
+        order = [q.pop().tenant for _ in range(9)]
+        # weight-proportional service under contention: 2:1
+        assert order.count("a") == 6 and order.count("b") == 3
+
+    def test_idle_tenant_forfeits_credit(self):
+        q = FairQueue(64, tenants={"a": TenantConfig(weight=5.0)})
+        q.push(_req(0, tenant="a"))
+        assert q.pop().tenant == "a"
+        q.push(_req(1, tenant="b"))
+        assert q.pop().tenant == "b"
+        # "a" left the ring when it emptied; no banked burst remains
+        assert q._deficit.get("a", 0.0) == 0.0
+
+    def test_interactive_bypasses_drr(self):
+        q = FairQueue(64)
+        for i in range(4):
+            q.push(_req(i, tenant="bulk"))
+        q.push(_req(50, priority=0, tenant="other"))
+        got = q.pop()
+        assert got.priority == 0 and got.tenant == "other"
+
+    def test_tenant_quota(self):
+        q = FairQueue(64, tenants={"lim": TenantConfig(rate=0.5, burst=1.0)})
+        ok, shed, reason = q.push(_req(0, tenant="lim"), now=0.0)
+        assert ok and shed is None and reason is None
+        ok, shed, reason = q.push(_req(1, tenant="lim"), now=0.0)
+        assert not ok and shed is not None and reason == "tenant_quota"
+        ok, _, reason = q.push(_req(2, tenant="lim"), now=10.0)
+        assert ok and reason is None  # bucket refilled
+        # unlimited tenants never consult a bucket
+        ok, _, reason = q.push(_req(3, tenant="free"), now=0.0)
+        assert ok and reason is None
+
+    def test_displace_and_reject(self):
+        q = FairQueue(2)
+        q.push(_req(0, priority=2))
+        q.push(_req(1, priority=2))
+        ok, shed, reason = q.push(_req(2, priority=1))
+        assert ok and shed is not None and shed.seq == 1
+        assert reason == "displaced" and len(q) == 2
+        ok, shed, reason = q.push(_req(3, priority=2))
+        assert not ok and shed.seq == 3 and reason == "rejected"
+        assert len(q) == 2
+
+    def test_requeue_bypasses_bound_and_bucket(self):
+        q = FairQueue(1, tenants={"lim": TenantConfig(rate=1e-9, burst=1.0)})
+        ok, _, _ = q.push(_req(0, tenant="lim"), now=0.0)
+        assert ok
+        back = _req(1, tenant="lim")
+        q.requeue(back)  # crashed-shard path: full queue, empty bucket
+        assert len(q) == 2 and back.requeues == 1
+
+    def test_remove_expired_across_tenants(self):
+        q = FairQueue(8)
+        q.push(_req(0, tenant="a", deadline=1.0))
+        q.push(_req(1, tenant="b", deadline=5.0))
+        expired = q.remove_expired(2.0)
+        assert [r.seq for r in expired] == [0] and len(q) == 1
+
+
+# ---------------------------------------------------------------------
+# router (stub shards)
+# ---------------------------------------------------------------------
+class _Stub:
+    def __init__(self, shard_id, bucket=2, inflight=0):
+        self.shard_id = shard_id
+        self.bucket = bucket
+        self._n = inflight
+
+    def inflight(self):
+        return self._n
+
+
+class TestRouter:
+    def test_capacity_filter(self):
+        r = Router()
+        assert r.pick(_req(0), [_Stub(0, inflight=2), _Stub(1, inflight=2)]) is None
+
+    def test_least_loaded(self):
+        r = Router()
+        shards = [_Stub(0, inflight=1), _Stub(1, inflight=0)]
+        assert r.pick(_req(0), shards).shard_id == 1
+
+    def test_affinity_within_slack_only(self):
+        r = Router(affinity_slack=1)
+        warm, cold = _Stub(0, bucket=4, inflight=1), _Stub(1, bucket=4)
+        req = _req(0, fingerprint="fp")
+        r.note_dispatch(req, warm)
+        # warm shard is 1 deeper than least-loaded: within slack, wins
+        assert r.pick(req, [warm, cold]).shard_id == 0
+        warm._n = 3  # now 3 deeper: affinity must not create a hotspot
+        assert r.pick(req, [warm, cold]).shard_id == 1
+
+    def test_interactive_skips_affinity(self):
+        r = Router()
+        warm, cold = _Stub(0, bucket=4, inflight=1), _Stub(1, bucket=4)
+        r.note_dispatch(_req(0, fingerprint="fp"), warm)
+        urgent = _req(1, priority=0, fingerprint="fp")
+        assert r.pick(urgent, [warm, cold]).shard_id == 1
+
+    def test_forget_shard(self):
+        r = Router(affinity_slack=4)
+        warm, cold = _Stub(0, bucket=4, inflight=1), _Stub(1, bucket=4)
+        req = _req(0, fingerprint="fp")
+        r.note_dispatch(req, warm)
+        r.forget_shard(0)  # crashed: the respawn has nothing warm
+        assert r.pick(req, [warm, cold]).shard_id == 1
+
+
+# ---------------------------------------------------------------------
+# fleet semantics with stub shards (fake clock, no child processes)
+# ---------------------------------------------------------------------
+class FakeShard:
+    """ShardProcess surface with no child: accepts dispatches, never
+    answers, dies on command — drives the supervision paths alone."""
+
+    def __init__(self, shard_id, bucket=2):
+        self.shard_id = shard_id
+        self.bucket = bucket
+        self.solver_kw = {"max_iter": 40}
+        self.lanes = {}
+        self.proc = None
+        self.spawned_at = 0.0
+        self.spawn_count = 0
+        self.last_ping = None
+        self.last_pong = 0.0
+        self._alive = False
+
+    def spawn(self):
+        self._alive = True
+        self.spawn_count += 1
+        self.spawned_at = time.monotonic()
+        self.last_ping = None
+        self.last_pong = self.spawned_at
+
+    def die(self):
+        self._alive = False
+
+    def kill(self):
+        self._alive = False
+
+    def alive(self):
+        return self._alive
+
+    def exit_code(self):
+        return None if self._alive else -9
+
+    def wedged(self, heartbeat_timeout):
+        return False
+
+    def ping(self):
+        self.last_ping = self.last_pong = time.monotonic()
+
+    def poll(self):
+        return []
+
+    def solve(self, lane, req):
+        if not self._alive:
+            return False
+        self.lanes[lane] = req
+        return True
+
+    def cancel(self, lane):
+        self.lanes.pop(lane, None)
+
+    def inject_fault(self, mode):
+        return self._alive
+
+    def inflight(self):
+        return len(self.lanes)
+
+
+class TestFleetFakeClock:
+    def _fleet(self, shards, clk, **kw):
+        kw.setdefault("respawn_backoff", 0.05)
+        return FleetService(shards, clock=clk, cache=None, **kw)
+
+    def test_tenant_quota_resolves_synchronously(self):
+        clk = FakeClock()
+        fleet = self._fleet(
+            [FakeShard(0)], clk,
+            tenants={"lim": TenantConfig(rate=1e-9, burst=1.0)},
+        )
+        t1 = fleet.submit(_lp(0), tenant="lim")
+        t2 = fleet.submit(_lp(1), tenant="lim")
+        assert not t1.done()  # admitted, queued
+        assert t2.done() and t2.result(0).verdict == "shed_tenant_quota"
+        assert t2.result(0).solution is None
+        assert fleet.tenant_shed == {"lim": 1}
+
+    def test_queued_and_inflight_deadlines(self):
+        clk = FakeClock()
+        fleet = self._fleet([FakeShard(0, bucket=1)], clk)
+        t1 = fleet.submit(_lp(0), timeout=5.0)  # will occupy the one lane
+        t2 = fleet.submit(_lp(1), timeout=1.0)  # expires while queued
+        fleet.pump()
+        clk.advance(2.0)
+        fleet.pump()
+        assert t2.done() and t2.result(0).verdict == "deadline_exceeded"
+        clk.advance(10.0)
+        fleet.pump()
+        # in-flight expiry: no best iterate crosses the process boundary
+        r1 = t1.result(0)
+        assert r1.verdict == "deadline_exceeded" and r1.solution is None
+        assert fleet.deadline_total == 2
+
+    def test_crash_requeues_respawns_and_sheds_nothing(self):
+        clk = FakeClock()
+        fake = FakeShard(0, bucket=2)
+        fleet = self._fleet([fake], clk)
+        tickets = [fleet.submit(_lp(s)) for s in range(2)]
+        fleet.pump()
+        assert fake.inflight() == 2
+        fake.die()
+        fleet.pump()  # supervision downs the shard, requeues its lanes
+        st = fleet.shard_states()[0]
+        assert st["state"] == "down" and fleet.requeued_total == 2
+        assert len(fleet.queue) == 2
+        assert st["backoff_s"] == pytest.approx(0.1)  # doubled from 0.05
+        time.sleep(0.06)  # respawn schedule runs on the real clock
+        fleet.pump()
+        st = fleet.shard_states()[0]
+        assert st["state"] == "up" and st["respawns"] == 1
+        assert fleet.respawn_total == 1
+        assert fake.inflight() == 2  # re-dispatched after respawn
+        assert all(r.requeues == 1 for r in fake.lanes.values())
+        assert not any(t.done() for t in tickets)  # nothing lost, nothing shed
+        fleet.close()  # outstanding tickets resolve, never leak
+        assert all(
+            t.result(0).verdict == "deadline_exceeded" for t in tickets
+        )
+
+    def test_drain_timeout_sheds_queued(self):
+        clk = FakeClock()
+        fake = FakeShard(0, bucket=1)
+        fleet = self._fleet([fake], clk)
+        tickets = [fleet.submit(_lp(s)) for s in range(3)]
+        fleet.pump()
+        fleet.drain(timeout=0.0)
+        verdicts = sorted(t.result(0).verdict for t in tickets)
+        assert verdicts == ["deadline_exceeded", "shed", "shed"]
+        assert len(fleet.queue) == 0 and fake.inflight() == 0
+
+
+# ---------------------------------------------------------------------
+# real shard children: crash-domain contracts
+# ---------------------------------------------------------------------
+def _mk_fleet(n_shards, **kw):
+    kw.setdefault("chunk_iters", 2)
+    kw.setdefault("cache_size", None)
+    kw.setdefault("respawn_backoff", 0.05)
+    kw.setdefault("solver_kw", {"max_iter": 40})
+    return make_dense_fleet(n_shards, 2, **kw)
+
+
+def _await_inflight(fleet, deadline_s=60.0):
+    """Wait (against the running pump thread) until some up shard holds
+    in-flight lanes; returns its shard id."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        for sid, st in fleet.shard_states().items():
+            if st["state"] == "up" and st["inflight"] > 0:
+                return sid
+        time.sleep(0.005)
+    raise AssertionError("no shard ever held in-flight lanes")
+
+
+class TestFleetChildren:
+    def test_bitwise_vs_single_engine(self):
+        lps = [_lp(s) for s in range(4)]
+        fleet = _mk_fleet(2)
+        try:
+            tickets = [fleet.submit(lp) for lp in lps]
+            fleet.drain(timeout=240.0)
+            fleet_res = [t.result(0) for t in tickets]
+        finally:
+            fleet.close()
+        svc = make_dense_service(2, chunk_iters=2, max_iter=40,
+                                 cache_size=None)
+        tickets = [svc.submit(lp) for lp in lps]
+        svc.drain()
+        ref_res = [t.result(0) for t in tickets]
+        for got, ref in zip(fleet_res, ref_res):
+            assert got.verdict in ("healthy", "slow")
+            assert got.iterations == ref.iterations
+            for a, b in zip(got.solution, ref.solution):
+                assert _biteq(a, b)
+
+    def test_exit_fault_respawn_requeue_zero_lost(self):
+        fleet = _mk_fleet(2, solver_kw={"max_iter": 120})
+        try:
+            fleet.start()
+            tickets = [fleet.submit(_lp(100 + s)) for s in range(8)]
+            victim = _await_inflight(fleet)
+            fleet.kill_shard(victim)  # supervision must notice on its own
+            results = [t.result(timeout=240.0) for t in tickets]
+            assert all(r.solution is not None for r in results)
+            assert all(r.verdict in ("healthy", "slow") for r in results)
+            assert fleet.respawn_total >= 1
+            assert fleet.requeued_total >= 1
+            assert fleet.shed_total == 0 and fleet.deadline_total == 0
+        finally:
+            fleet.stop(drain=False)
+            fleet.close()
+
+    def test_hang_fault_trips_heartbeat(self):
+        fleet = _mk_fleet(
+            1, heartbeat_every=0.1, heartbeat_timeout=0.5,
+        )
+        try:
+            fleet.start()
+            tickets = [fleet.submit(_lp(200 + s)) for s in range(2)]
+            _await_inflight(fleet)
+            fleet.inject_fault(0, "hang")
+            results = [t.result(timeout=240.0) for t in tickets]
+            # the wedged child was killed, its lanes re-solved after respawn
+            assert all(r.verdict in ("healthy", "slow") for r in results)
+            assert fleet.respawn_total >= 1 and fleet.requeued_total >= 1
+        finally:
+            fleet.stop(drain=False)
+            fleet.close()
+
+    def test_nan_fault_surfaces_nonfinite(self):
+        fleet = _mk_fleet(1)
+        try:
+            fleet.start()
+            # warm the child first so the fault frame is processed before
+            # the poisoned solve
+            fleet.submit(_lp(300)).result(timeout=240.0)
+            fleet.inject_fault(0, "nan")
+            res = fleet.submit(_lp(301)).result(timeout=240.0)
+            assert res.verdict == "nonfinite"
+            assert not np.all(np.isfinite(np.asarray(res.solution.x)))
+        finally:
+            fleet.stop(drain=False)
+            fleet.close()
+
+    def test_die_on_start_backs_off_exponentially(self):
+        shard = ShardProcess(
+            0, bucket=2, chunk_iters=2, solver_kw={"max_iter": 8},
+            extra_env={DIE_ON_START_ENV: "1"},
+        )
+        fleet = FleetService(
+            [shard], cache=None,
+            respawn_backoff=0.05, respawn_backoff_cap=0.2, stable_after=99.0,
+        )
+        try:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 20.0:
+                fleet.pump()
+                if fleet.shard_states()[0]["respawns"] >= 3:
+                    break
+                time.sleep(0.02)
+            st = fleet.shard_states()[0]
+            assert st["respawns"] >= 3
+            # 0.05 doubled per failure, clamped at the cap
+            assert st["backoff_s"] == pytest.approx(0.2)
+        finally:
+            fleet.close()

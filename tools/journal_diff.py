@@ -63,8 +63,15 @@ _HIGHER_IS_BETTER = (
     "lanes_retired", "goodput", "terminal/complete",
 )
 
-# metrics zero-seeded on whichever side lacks them (see compare())
-_ZERO_SEEDED = ("solve_verdict_total", "journey/terminal/", "burn_rate")
+# metrics zero-seeded on whichever side lacks them (see compare()).
+# The fleet counters (shard respawns, requeued lanes, per-tenant quota
+# sheds) only exist once a shard crashed or a tenant hit its rate limit,
+# so a clean baseline has no such series — seeding makes them
+# appearing-from-zero regressions rather than silently uncompared.
+_ZERO_SEEDED = (
+    "solve_verdict_total", "journey/terminal/", "burn_rate",
+    "shard_respawn_total", "requeued_lanes_total", "serve_tenant_shed_total",
+)
 
 
 def lower_is_better(metric: str) -> bool:
@@ -576,6 +583,35 @@ def self_check(out=sys.stdout) -> int:
                         "journey/terminal/cache_hit": 30.0})
     checks.append(("new priority class / cache hits appearing pass",
                    False, any(r["regression"] for r in rows)))
+
+    # fleet counters (serve/fleet.py): shard respawns, requeued lanes,
+    # and per-tenant quota sheds are chaos/pressure evidence — absent
+    # from a clean baseline, so they gate appearing-from-zero
+    fbase = {
+        'metric/serve_shard_up{shard="0"}': 1.0,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def frun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(fbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    frun("identical fleet metrics pass", dict(fbase), False)
+    frun("shard respawns appearing from zero fail (zero-seeded)",
+         {**fbase, 'metric/shard_respawn_total{shard="0"}': 2.0}, True)
+    frun("requeued lanes appearing from zero fail (zero-seeded)",
+         {**fbase, 'metric/requeued_lanes_total{shard="1"}': 4.0}, True)
+    frun("tenant quota sheds appearing from zero fail (zero-seeded)",
+         {**fbase, 'metric/serve_tenant_shed_total{tenant="batch"}': 3.0},
+         True)
+    frun("shard_respawn_total present on both sides gates on growth",
+         {**fbase, 'metric/shard_respawn_total{shard="0"}': 0.0}, False)
+    rows = compare(
+        {**fbase, 'metric/shard_respawn_total{shard="0"}': 2.0},
+        {**fbase, 'metric/shard_respawn_total{shard="0"}': 6.0},
+    )
+    checks.append(("respawn count tripling fails (lower is better)",
+                   True, any(r["regression"] for r in rows)))
 
     ok = True
     for name, want, got in checks:

@@ -10,14 +10,23 @@ continuous-batching win.
 
     python tools/loadgen.py --requests 400 --rate 200 --bucket 8
     python tools/loadgen.py --baseline serial --requests 400 --rate 200
+    python tools/loadgen.py --shards 2 --kill-shard   # fleet chaos run
     python tools/loadgen.py --self-check          # CI smoke (CPU)
+
+`--shards N` serves the same open-loop schedule with the sharded fleet
+(`dispatches_tpu.serve.make_dense_fleet`: N crash-domain child
+processes); `--kill-shard` SIGKILLs the busiest shard halfway through
+the run to exercise the respawn + requeue path under load.
 
 `--self-check` pushes ~200 small LPs through the service, asserts every
 ticket resolves (zero lost requests) and every non-cached solve
 converges, and gates the measured p95 against a generous CPU bound via
 the `journal_diff` comparison machinery (so the gate's direction and
-threshold semantics match the rest of CI). Exit 0 pass / 1 gate trip /
-2 error.
+threshold semantics match the rest of CI). It also runs the fleet chaos
+leg: a 2-shard fleet with one shard killed mid-run must lose zero
+requests, respawn the dead shard, requeue its in-flight lanes, and
+return results bitwise identical to the single-engine service at the
+same bucket. Exit 0 pass / 1 gate trip / 2 error.
 
 The workload is synthetic: small random feasible box LPs with a
 configurable duplicate fraction (`--dup-frac`) so the fingerprint cache
@@ -114,18 +123,30 @@ def run_service(
     lp_m: int = 4,
     reqtrace: bool = False,
     detail: bool = False,
+    shards: int = 0,
+    kill_shard: bool = False,
 ) -> dict:
     """Drive the service at `rate` req/s; returns the report dict.
     `reqtrace` records per-request journeys into the process tracer's
     journal; `detail` adds a per-request-id latency map to the report
-    (for validation — omitted from normal reports to keep them small)."""
+    (for validation — omitted from normal reports to keep them small).
+    `shards > 0` serves through the sharded fleet instead of the
+    in-process engine; `kill_shard` SIGKILLs the busiest shard halfway
+    through the submissions (chaos: respawn + requeue under load)."""
     _enable_x64()
-    from dispatches_tpu.serve import make_dense_service
+    from dispatches_tpu.serve import make_dense_fleet, make_dense_service
 
-    svc = make_dense_service(
-        bucket, chunk_iters=chunk_iters, max_iter=max_iter,
-        queue_limit=queue_limit, reqtrace=reqtrace,
-    )
+    if shards > 0:
+        svc = make_dense_fleet(
+            shards, bucket, chunk_iters=chunk_iters,
+            queue_limit=queue_limit, reqtrace=reqtrace,
+            solver_kw={"max_iter": max_iter},
+        )
+    else:
+        svc = make_dense_service(
+            bucket, chunk_iters=chunk_iters, max_iter=max_iter,
+            queue_limit=queue_limit, reqtrace=reqtrace,
+        )
     seeds = problem_seeds(requests, dup_frac, seed)
     problems = {s: make_problem(s, n=lp_n, m=lp_m) for s in set(seeds)}
     # warm the executables outside the measurement window (a model server
@@ -138,6 +159,7 @@ def run_service(
     svc.start()
     t0 = time.monotonic()
     tickets = []
+    killed = None
     try:
         for i, (s, due) in enumerate(zip(seeds, sched)):
             lag = t0 + due - time.monotonic()
@@ -147,9 +169,20 @@ def run_service(
                 problems[s], request_id=f"r{i}",
                 timeout=deadline_s,
             ))
-        results = [t.result(timeout=120.0) for t in tickets]
+            if kill_shard and killed is None and i >= requests // 2:
+                busy = [
+                    k for k, st in svc.shard_states().items()
+                    if st["state"] == "up" and st["inflight"] > 0
+                ]
+                if busy:
+                    svc.kill_shard(busy[0])
+                    killed = busy[0]
+        results = [t.result(timeout=240.0) for t in tickets]
     finally:
-        svc.stop()
+        if shards > 0:
+            svc.close()
+        else:
+            svc.stop()
     wall = time.monotonic() - t0
 
     ok = [r for r in results if r.ok]
@@ -176,6 +209,10 @@ def run_service(
         **_percentiles(lat),
         "service": svc.stats(),
     }
+    if shards > 0:
+        report["mode"] = "fleet"
+        report["shards"] = shards
+        report["killed_shard"] = killed
     if detail:
         report["latencies_by_id"] = {
             r.request_id: r.latency for r in results
@@ -275,6 +312,130 @@ def _terminal_mini_pass(out) -> dict:
         rid: r.latency for rid, r in results.items()
         if r.latency is not None
     }
+
+
+def _fleet_chaos_pass(out) -> list:
+    """The fleet's acceptance scenario: a 2-shard fleet with one shard
+    SIGKILLed while it holds in-flight lanes must (a) lose zero tickets,
+    (b) respawn the dead shard, (c) requeue and re-solve the killed
+    lanes, and (d) return every result bitwise identical to the
+    single-engine service at the same bucket (requeued lanes re-solve
+    from iteration 0, so the crash leaves no numeric trace). Also covers
+    the ``shed_tenant_quota`` verdict via a rate-limited tenant."""
+    import numpy as np
+
+    from dispatches_tpu.serve import (
+        TenantConfig,
+        make_dense_fleet,
+        make_dense_service,
+    )
+
+    failures = []
+    bucket = 4
+    seeds = list(range(8000, 8024))
+    problems = {s: make_problem(s) for s in seeds}
+    fleet = make_dense_fleet(
+        2, bucket, chunk_iters=4, cache_size=None,
+        tenants={"limited": TenantConfig(rate=0.001, burst=1.0)},
+        solver_kw={"max_iter": 60},
+    )
+    lost = 0
+    results = {}
+    try:
+        tickets = {
+            s: fleet.submit(problems[s], priority="batch",
+                            request_id=f"chaos{s}")
+            for s in seeds
+        }
+        # token bucket: burst 1.0 admits the first, sheds the second at
+        # the door with the tenant-quota verdict
+        t_ok = fleet.submit(
+            make_problem(8100), priority="batch", tenant="limited",
+        )
+        t_quota = fleet.submit(
+            make_problem(8101), priority="batch", tenant="limited",
+        )
+        if t_quota.done() and t_quota.result(0).verdict == "shed_tenant_quota":
+            print("fleet chaos: tenant quota shed observed", file=out)
+        else:
+            failures.append("fleet chaos: expected shed_tenant_quota verdict")
+        # pump until some shard holds in-flight lanes, then kill it cold
+        victim = None
+        t0 = time.monotonic()
+        while victim is None and time.monotonic() - t0 < 60.0:
+            fleet.pump()
+            busy = [
+                k for k, st in fleet.shard_states().items()
+                if st["state"] == "up" and st["inflight"] > 0
+            ]
+            if busy:
+                victim = busy[0]
+        if victim is None:
+            failures.append("fleet chaos: no shard ever held in-flight work")
+        else:
+            n_inflight = fleet.shard_states()[victim]["inflight"]
+            fleet.kill_shard(victim)
+            print(
+                f"fleet chaos: killed shard {victim} with "
+                f"{n_inflight} lanes in flight", file=out,
+            )
+        fleet.drain(timeout=300.0)
+        st = fleet.stats()
+        for s, t in tickets.items():
+            if t.done():
+                results[s] = t.result(0)
+            else:
+                lost += 1
+        lost += (not t_ok.done()) + (not t_quota.done())
+        if lost:
+            failures.append(f"fleet chaos: {lost} tickets never resolved")
+        bad = [s for s, r in results.items() if r.verdict not in
+               ("healthy", "slow")]
+        if bad:
+            failures.append(
+                f"fleet chaos: {len(bad)} non-healthy results "
+                f"(first: {[(s, results[s].verdict) for s in bad[:3]]})"
+            )
+        if victim is not None and st["respawns"] < 1:
+            failures.append("fleet chaos: killed shard never respawned")
+        if victim is not None and st["requeued_lanes"] < 1:
+            failures.append("fleet chaos: no in-flight lanes were requeued")
+        print(
+            f"fleet chaos: {len(results)}/{len(seeds)} resolved, "
+            f"respawns={st['respawns']} requeued={st['requeued_lanes']} "
+            f"tenant_shed={st['tenant_shed']}", file=out,
+        )
+    finally:
+        fleet.close()
+
+    if lost or not results:
+        return failures  # bitwise comparison needs a full result set
+
+    svc = make_dense_service(
+        bucket, chunk_iters=4, max_iter=60, cache_size=None,
+    )
+    ref = {
+        s: svc.submit(problems[s], priority="batch") for s in seeds
+    }
+    svc.drain()
+    mismatched = 0
+    for s in seeds:
+        a, b = results[s].solution, ref[s].result(0).solution
+        for la, lb in zip(a, b):
+            if np.asarray(la).tobytes() != np.asarray(lb).tobytes():
+                mismatched += 1
+                break
+    if mismatched:
+        failures.append(
+            f"fleet chaos: {mismatched} results differ bitwise from the "
+            "single-engine service"
+        )
+    else:
+        print(
+            f"fleet chaos: all {len(seeds)} results bitwise-identical to "
+            "the single-engine service", file=out,
+        )
+    return failures
 
 
 def _check_journeys(journal, latencies, out) -> list:
@@ -377,6 +538,7 @@ def self_check(out=sys.stdout) -> int:
         )
         latencies = report.pop("latencies_by_id")
         latencies.update(_terminal_mini_pass(out))
+        chaos_failures = _fleet_chaos_pass(out)
         tr.event("loadgen_report", **{
             k: v for k, v in report.items() if isinstance(v, (int, float))
         })
@@ -384,6 +546,7 @@ def self_check(out=sys.stdout) -> int:
 
     print(json.dumps(report, indent=2, default=str), file=out)
     failures = []
+    failures += chaos_failures
     failures += _check_journeys(journal, latencies, out)
     if report["lost"]:
         failures.append(f"{report['lost']} lost requests")
@@ -446,6 +609,12 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline", type=float, default=None,
                     help="per-request deadline, seconds from submit")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shards", type=int, default=0,
+                    help="serve through a fleet of N crash-domain shard "
+                    "processes instead of the in-process engine")
+    ap.add_argument("--kill-shard", action="store_true",
+                    help="chaos: SIGKILL the busiest shard halfway through "
+                    "the run (requires --shards >= 2)")
     ap.add_argument("--baseline", choices=["serial"], default=None,
                     help="run the one-at-a-time baseline instead")
     ap.add_argument("--json", action="store_true",
@@ -464,6 +633,10 @@ def main(argv=None) -> int:
 
     if args.self_check:
         return self_check()
+
+    if args.kill_shard and args.shards < 2:
+        ap.error("--kill-shard needs --shards >= 2 (a 1-shard fleet "
+                 "killed mid-run has nowhere to requeue)")
 
     if args.baseline == "serial":
         report = run_serial(
@@ -484,6 +657,7 @@ def main(argv=None) -> int:
                 chunk_iters=args.chunk_iters, max_iter=args.max_iter,
                 queue_limit=args.queue_limit, dup_frac=args.dup_frac,
                 seed=args.seed, deadline_s=args.deadline, reqtrace=reqtrace,
+                shards=args.shards, kill_shard=args.kill_shard,
             )
         finally:
             if tracer is not None:

@@ -13,6 +13,7 @@ Request lines:
                  "l": [...], "u": [...], "c0": 0.0},
      "priority": "interactive" | "normal" | "batch",   # default normal
      "timeout": 0.5,                                    # optional, seconds
+     "tenant": "team-a",                 # fairness id (--shards mode only)
      "traceparent": "00-<32hex>-<16hex>-01"}            # optional caller ctx
     {"op": "stats"}        # service counters + latency percentiles
     {"op": "drain"}        # block until queue and slots are empty
@@ -32,7 +33,10 @@ journal manifest onto the spawning process.
 
 The service (bucket size, solver options) is built from the CLI flags at
 the FIRST solve request, using that problem's shapes; every later
-problem must match them. Unknown ops and malformed lines produce an
+problem must match them. With ``--shards N`` the back end is the sharded
+fleet (`make_dense_fleet`: N crash-domain child processes with respawn
+and per-tenant fairness — requests may carry a ``tenant`` id) instead of
+the in-process engine. Unknown ops and malformed lines produce an
 ``{"error": ...}`` response instead of killing the loop.
 """
 from __future__ import annotations
@@ -139,6 +143,9 @@ def main(argv=None, out=sys.stdout) -> int:
     ap.add_argument("--max-iter", type=int, default=60)
     ap.add_argument("--queue-limit", type=int, default=256)
     ap.add_argument("--cache-size", type=int, default=256)
+    ap.add_argument("--shards", type=int, default=0,
+                    help="serve through a fleet of N crash-domain shard "
+                    "processes (0 = in-process engine)")
     ap.add_argument("--journal", default=None,
                     help="write a JSONL run journal here")
     ap.add_argument("--reqtrace", action="store_true",
@@ -150,7 +157,7 @@ def main(argv=None, out=sys.stdout) -> int:
     jax.config.update("jax_enable_x64", True)  # tools convention: f64 on CPU
 
     from dispatches_tpu.obs.journal import Tracer, set_tracer
-    from dispatches_tpu.serve import make_dense_service
+    from dispatches_tpu.serve import make_dense_fleet, make_dense_service
 
     tracer = None
     if args.journal:
@@ -171,20 +178,34 @@ def main(argv=None, out=sys.stdout) -> int:
                 if op == "solve":
                     lp = _parse_problem(req["problem"])
                     if svc is None:
-                        svc = make_dense_service(
-                            args.bucket, chunk_iters=args.chunk_iters,
-                            max_iter=args.max_iter,
-                            queue_limit=args.queue_limit,
-                            cache_size=args.cache_size or None,
-                            reqtrace=args.reqtrace,
-                        )
+                        if args.shards > 0:
+                            svc = make_dense_fleet(
+                                args.shards, args.bucket,
+                                chunk_iters=args.chunk_iters,
+                                queue_limit=args.queue_limit,
+                                cache_size=args.cache_size or None,
+                                reqtrace=args.reqtrace,
+                                solver_kw={"max_iter": args.max_iter},
+                            )
+                        else:
+                            svc = make_dense_service(
+                                args.bucket, chunk_iters=args.chunk_iters,
+                                max_iter=args.max_iter,
+                                queue_limit=args.queue_limit,
+                                cache_size=args.cache_size or None,
+                                reqtrace=args.reqtrace,
+                            )
                         svc.start()
+                    kw = {}
+                    if args.shards > 0:
+                        kw["tenant"] = req.get("tenant", "default")
                     reaper.watch(svc.submit(
                         lp,
                         priority=req.get("priority", "normal"),
                         timeout=req.get("timeout"),
                         request_id=req.get("id"),
                         trace_ctx=req.get("traceparent"),
+                        **kw,
                     ))
                 elif op == "stats":
                     reaper.emit(
@@ -204,6 +225,8 @@ def main(argv=None, out=sys.stdout) -> int:
             fh.close()
         if svc is not None:
             svc.stop(drain=True)
+            if args.shards > 0:
+                svc.close()  # reap the shard children
         reaper.close()
         if tracer is not None:
             set_tracer(None)

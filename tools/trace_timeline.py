@@ -6,7 +6,10 @@ Stdlib-only (like journal_diff / trace_summary): reads the schema-v3
 Trace Event Format that chrome://tracing and Perfetto load directly —
 one track (tid) per slot lane showing chunk segments, plus a queue
 track showing each request's admission-queue residency and the
-shed / deadline / cache-hit instants.
+shed / deadline / cache-hit instants. Fleet journals (chunks carrying a
+``shard`` field) get one track per (shard, slot) pair — named
+``shard K · slot S`` — so a respawn-and-requeue shows up as the same
+request hopping tracks.
 
 Usage:
     python tools/trace_timeline.py JOURNAL.jsonl -o timeline.trace.json
@@ -25,8 +28,16 @@ from typing import Any, Dict, List, Optional
 
 RC_OK, RC_ERROR = 0, 2
 
-QUEUE_TID = 0  # slot lanes are tid 1 + slot index
+QUEUE_TID = 0  # lane tracks get sequential tids starting at 1
 _US = 1e6  # journey stamps are seconds; trace events want microseconds
+
+
+def _lane_key(chunk: dict):
+    """Track identity of a chunk: (shard, slot). Single-engine journals
+    have no shard field; -1 sorts their tracks ahead of any fleet shard
+    (and keeps slot 0 on tid 1, as before the fleet existed)."""
+    shard = chunk.get("shard")
+    return (shard if isinstance(shard, int) else -1, chunk["slot"])
 
 
 def read_jsonl(path: str) -> List[dict]:
@@ -83,11 +94,13 @@ def export_trace(records: List[dict]) -> Dict[str, Any]:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
     origin = min(j["t0"] for j in js)
     lanes = sorted({
-        c.get("slot") for j in js for c in j.get("chunks", [])
+        _lane_key(c) for j in js for c in j.get("chunks", [])
         if isinstance(c.get("slot"), int)
     })
-    for slot in lanes:
-        events.append(_meta(pid, 1 + slot, f"slot {slot}", "thread_name"))
+    lane_tid = {key: 1 + i for i, key in enumerate(lanes)}
+    for (shard, slot), tid in sorted(lane_tid.items(), key=lambda kv: kv[1]):
+        name = f"slot {slot}" if shard < 0 else f"shard {shard} · slot {slot}"
+        events.append(_meta(pid, tid, name, "thread_name"))
 
     for j in js:
         t0 = float(j["t0"])
@@ -112,25 +125,31 @@ def export_trace(records: List[dict]) -> Dict[str, Any]:
                 "dur": float(qw) * _US, "args": args,
             })
         # chunk segments on the lane tracks
+        last_key = None
         for c in j.get("chunks", []):
             if not isinstance(c.get("slot"), int):
                 continue
+            last_key = _lane_key(c)
             events.append({
-                "ph": "X", "pid": pid, "tid": 1 + c["slot"], "cat": "chunk",
-                "name": name,
+                "ph": "X", "pid": pid, "tid": lane_tid[last_key],
+                "cat": "chunk", "name": name,
                 "ts": (t0 + float(c.get("t", 0.0)) - origin) * _US,
                 "dur": max(float(c.get("dur", 0.0)), 0.0) * _US,
-                "args": {**args, "it0": c.get("it0"), "it1": c.get("it1")},
+                "args": {
+                    **args, "it0": c.get("it0"), "it1": c.get("it1"),
+                    **({"shard": c["shard"]} if "shard" in c else {}),
+                },
             })
         # harvest transfer rides the lane track too, right after compute
         hv = phases.get("harvest_s")
-        if isinstance(hv, (int, float)) and hv > 0 and isinstance(j.get("slot"), int):
+        if isinstance(hv, (int, float)) and hv > 0 and last_key is not None:
             off = sum(
                 float(phases.get(k) or 0.0)
                 for k in ("admit_s", "queue_wait_s", "slot_admit_s", "compute_s")
             )
             events.append({
-                "ph": "X", "pid": pid, "tid": 1 + j["slot"], "cat": "harvest",
+                "ph": "X", "pid": pid, "tid": lane_tid[last_key],
+                "cat": "harvest",
                 "name": f"{name} harvest", "ts": (t0 + off - origin) * _US,
                 "dur": float(hv) * _US, "args": args,
             })
@@ -217,6 +236,18 @@ def _synthetic_journeys() -> List[dict]:
             "r3", 3, "deadline_exceeded", 10.003,
             {"admit_s": 0.0, "queue_wait_s": 0.01, "respond_s": 0.001}, [], None,
         ),
+        # a fleet-served request whose first shard crashed mid-solve: one
+        # segment on shard 0, the requeued re-solve on shard 1
+        journey(
+            "r4", 4, "complete", 10.004,
+            {"admit_s": 0.0, "queue_wait_s": 0.003, "compute_s": 0.02,
+             "respond_s": 0.0005},
+            [{"t": 0.003, "dur": 0.005, "it0": 0, "it1": 8, "slot": 1,
+              "shard": 0},
+             {"t": 0.013, "dur": 0.01, "it0": 0, "it1": 16, "slot": 0,
+              "shard": 1}],
+            0,
+        ),
     ]
 
 
@@ -237,6 +268,16 @@ def self_check() -> int:
         ("queue spans on queue track", any(
             e.get("cat") == "queue" and e.get("tid") == QUEUE_TID for e in evs
         )),
+        ("per-shard lane tracks named", sum(
+            1 for e in evs
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+            and str(e.get("args", {}).get("name", "")).startswith("shard ")
+        ) == 2),
+        ("requeued request spans two shard tracks", len({
+            e["tid"] for e in evs
+            if e.get("cat") == "chunk"
+            and e.get("args", {}).get("request_id") == "r4"
+        }) == 2),
         ("round-trips through JSON", json.loads(json.dumps(trace)) == trace),
         ("empty journal degrades", validate_trace(
             export_trace([{"kind": "manifest"}])
