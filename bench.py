@@ -1321,12 +1321,21 @@ def main():
         "wins_gated": not _OFF_RECORD,
         "gate_ok": sv_ok,
     }
+    # Fleet loadgen runs (shards > 0) report a per-shard goodput/latency
+    # breakdown; fold it into the diagnostic row when present so
+    # BENCH_DIAG.json carries the shard-level picture alongside the
+    # fleet-level ratios. The default bench row is single-engine, so
+    # this is usually absent.
+    if sv.get("per_shard"):
+        _LOCAL["rows"]["serve_loadgen"]["per_shard"] = sv["per_shard"]
     _DIAG.setdefault("serve", {})["loadgen"] = {
         k: _LOCAL["rows"]["serve_loadgen"][k]
         for k in ("service_goodput_rps", "serial_goodput_rps",
                   "service_p95_s", "serial_p95_s", "goodput_ratio",
                   "p95_ratio", "batching_wins", "wins_gated", "gate_ok")
     }
+    if sv.get("per_shard"):
+        _DIAG["serve"]["loadgen"]["per_shard"] = sv["per_shard"]
     _atomic_dump(_DIAG, _DIAG_PATH)
     _flush_local()
     _journal().event(

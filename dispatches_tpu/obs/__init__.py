@@ -28,6 +28,12 @@ Three pillars (see docs/observability.md):
    slot_admit / chunk compute / harvest / respond) with W3C-style trace
    contexts that survive process hops, schema-v3 ``journey`` journal
    records, and multi-window SLO burn-rate evaluation over them.
+9. **Fleet telemetry plane** (`obs.metrics.merge` / `obs.exporter`):
+   shard children ship registry snapshot *deltas* over the serve-tier
+   frame pipe; the parent merges them under a ``shard`` label with the
+   fleet aggregate equal to the sum of per-shard series by
+   construction, and `TelemetryExporter` serves the merged view over
+   ``/metrics`` + ``/healthz`` + ``/slo``.
 """
 from .cost import (  # noqa: F401
     chip_peak_tflops,
@@ -60,6 +66,7 @@ from .journal import (  # noqa: F401
     set_tracer,
     use_tracer,
 )
+from .exporter import TelemetryExporter, start_exporter  # noqa: F401
 from .memory import device_memory_stats, memory_watermark_bytes  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
@@ -67,11 +74,14 @@ from .metrics import (  # noqa: F401
     describe,
     get_registry,
     inc,
+    merge_snapshot,
     observe,
+    parse_series,
     render_prometheus,
     reset_metrics,
     set_gauge,
     snapshot,
+    snapshot_delta,
 )
 from .profile import (  # noqa: F401
     annotation,
@@ -149,6 +159,11 @@ __all__ = [
     "render_prometheus",
     "reset_metrics",
     "counter_delta",
+    "parse_series",
+    "snapshot_delta",
+    "merge_snapshot",
+    "TelemetryExporter",
+    "start_exporter",
     "compiled_cost",
     "lp_solve_cost",
     "lp_banded_cost",

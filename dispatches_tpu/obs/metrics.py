@@ -64,6 +64,49 @@ def series_name(name: str, labels: Mapping[str, Any]) -> str:
     return f"{name}{{{inner}}}"
 
 
+def parse_series(series: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of `series_name`: ``'name{k="v",...}'`` -> ``(name,
+    {k: v})`` with exposition-format escapes (``\\\\``, ``\\"``, ``\\n``)
+    undone, so a round trip through `series_name` is exact even for
+    label values containing quotes or backslashes. Raises ValueError on
+    a malformed series string."""
+    if "{" not in series:
+        return series, {}
+    name, rest = series.split("{", 1)
+    if not rest.endswith("}"):
+        raise ValueError(f"unterminated label block in series {series!r}")
+    body = rest[:-1]
+    labels: Dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        eq = body.find("=", i)
+        if eq < 0 or eq + 1 >= n or body[eq + 1] != '"':
+            raise ValueError(f"malformed label pair in series {series!r}")
+        key = body[i:eq]
+        j = eq + 2
+        buf = []
+        while j < n:
+            c = body[j]
+            if c == "\\" and j + 1 < n:
+                nxt = body[j + 1]
+                buf.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, "\\" + nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        else:
+            raise ValueError(f"unterminated label value in series {series!r}")
+        labels[key] = "".join(buf)
+        i = j + 1
+        if i < n:
+            if body[i] != ",":
+                raise ValueError(f"malformed label separator in series {series!r}")
+            i += 1
+    return name, labels
+
+
 class _Histogram:
     __slots__ = ("buckets", "counts", "sum", "count")
 
@@ -170,6 +213,90 @@ class MetricsRegistry:
                     for (n, ls), h in self._hists.items()
                 },
             }
+
+    def merge(self, snap: Mapping[str, Any], **labels: Any) -> int:
+        """Fold a `snapshot()` (or `snapshot_delta`) from another registry
+        into this one, re-labeling every series with the extra `labels`
+        (e.g. ``shard="3"``). The cross-process aggregation primitive of
+        the fleet telemetry plane:
+
+        - **Counters and histograms are deltas.** Each incoming value is
+          *added* to both the re-labeled series and the original
+          label-free series, under one lock acquisition — so the fleet
+          aggregate equals the sum of the per-shard series by
+          construction, and a respawned child shipping from a fresh zero
+          baseline can only ever add (monotonicity survives respawn as
+          long as the sender ships deltas, which `snapshot_delta`
+          guarantees).
+        - **Gauges are absolute**, last-write-wins, and get only the
+          re-labeled series: a sum of per-shard gauges is meaningless, so
+          no aggregate series is written.
+        - **Histogram buckets merge bucket-wise** when the bucket ladder
+          matches (the common case — both sides use the same describe
+          site); mismatched ladders re-bucket each incoming count at its
+          upper bound, which is lossy in the same way any histogram is.
+
+        Returns the number of series folded in. Raises ValueError on a
+        malformed snapshot (callers own the error accounting)."""
+        extra = {str(k): str(v) for k, v in labels.items()}
+        merged = 0
+        with self._lock:
+            for series, v in (snap.get("counters") or {}).items():
+                name, ls = parse_series(series)
+                v = float(v)
+                if not v:
+                    continue
+                keys = [_series_key(name, {**ls, **extra})]
+                if extra:
+                    keys.append(_series_key(name, ls))
+                for key in keys:
+                    self._counters[key] = self._counters.get(key, 0.0) + v
+                merged += 1
+            for series, v in (snap.get("gauges") or {}).items():
+                name, ls = parse_series(series)
+                self._gauges[_series_key(name, {**ls, **extra})] = float(v)
+                merged += 1
+            for series, h in (snap.get("histograms") or {}).items():
+                if not h.get("count") and not h.get("sum"):
+                    continue
+                name, ls = parse_series(series)
+                keys = [_series_key(name, {**ls, **extra})]
+                if extra:
+                    keys.append(_series_key(name, ls))
+                for key in keys:
+                    self._merge_hist_locked(key, h)
+                merged += 1
+        return merged
+
+    def _merge_hist_locked(self, key: _SeriesKey, hsnap: Mapping[str, Any]) -> None:
+        incoming = sorted(
+            (float("inf") if b == "+Inf" else float(b), int(c))
+            for b, c in (hsnap.get("buckets") or {}).items()
+        )
+        bounds = tuple(b for b, _ in incoming if b != float("inf"))
+        h = self._hists.get(key)
+        if h is None:
+            h = self._hists[key] = _Histogram(bounds or DEFAULT_BUCKETS)
+        if (
+            bounds == h.buckets
+            and len(incoming) == len(h.counts)
+            and incoming
+            and incoming[-1][0] == float("inf")
+        ):
+            for i, (_, c) in enumerate(incoming):
+                h.counts[i] += c
+        else:
+            for b, c in incoming:  # ladder mismatch: re-bucket by bound
+                if not c:
+                    continue
+                for i, ub in enumerate(h.buckets):
+                    if b <= ub:
+                        h.counts[i] += c
+                        break
+                else:
+                    h.counts[-1] += c
+        h.sum += float(hsnap.get("sum", 0.0))
+        h.count += int(hsnap.get("count", 0))
 
     def histogram_quantile(
         self, name: str, q: float, **labels: Any
@@ -281,6 +408,47 @@ def counter_delta(
     return out
 
 
+def snapshot_delta(
+    before: Mapping[str, Any], after: Mapping[str, Any]
+) -> Dict[str, Dict[str, Any]]:
+    """Per-series increase between two `snapshot()` dicts, in the same
+    shape as a snapshot — the wire unit a shard child ships to its
+    parent. Counters and histograms carry deltas (series with no change
+    are dropped); gauges carry the absolute `after` value (a gauge delta
+    is not meaningful). Feeding the result to `MetricsRegistry.merge`
+    keeps fleet aggregates monotone across sender restarts: a fresh
+    child's first delta is computed against an empty `before`, so it can
+    never go negative."""
+    counters: Dict[str, float] = {}
+    b_counters = before.get("counters") or {}
+    for series, v in (after.get("counters") or {}).items():
+        d = float(v) - float(b_counters.get(series, 0.0))
+        if d:
+            counters[series] = d
+    hists: Dict[str, Dict[str, Any]] = {}
+    b_hists = before.get("histograms") or {}
+    for series, h in (after.get("histograms") or {}).items():
+        prev = b_hists.get(series) or {}
+        prev_buckets = prev.get("buckets") or {}
+        d_count = int(h.get("count", 0)) - int(prev.get("count", 0))
+        d_sum = float(h.get("sum", 0.0)) - float(prev.get("sum", 0.0))
+        if not d_count and not d_sum:
+            continue
+        hists[series] = {
+            "count": d_count,
+            "sum": d_sum,
+            "buckets": {
+                b: int(c) - int(prev_buckets.get(b, 0))
+                for b, c in (h.get("buckets") or {}).items()
+            },
+        }
+    return {
+        "counters": counters,
+        "gauges": dict(after.get("gauges") or {}),
+        "histograms": hists,
+    }
+
+
 _REGISTRY = MetricsRegistry()
 
 
@@ -323,6 +491,10 @@ def flat_values() -> Dict[str, float]:
 
 def render_prometheus() -> str:
     return _REGISTRY.render_prometheus()
+
+
+def merge_snapshot(snap: Mapping[str, Any], **labels: Any) -> int:
+    return _REGISTRY.merge(snap, **labels)
 
 
 def reset_metrics() -> None:

@@ -76,6 +76,35 @@ obs_metrics.describe(
 obs_metrics.describe(
     "serve_shard_inflight", "Lanes currently dispatched to each shard.",
 )
+obs_metrics.describe(
+    "serve_shard_ping_seconds",
+    "Heartbeat round-trip latency per shard (parent send to pong "
+    "receipt); the tail of this histogram is the wedge-detection signal.",
+)
+obs_metrics.describe(
+    "serve_shard_last_pong_age_seconds",
+    "Seconds since each up shard last answered a heartbeat (real "
+    "monotonic clock; ages approaching heartbeat_timeout mean a wedge).",
+)
+obs_metrics.describe(
+    "serve_shard_requests_total",
+    "Requests resolved per shard (the per-shard view of "
+    "serve_requests_total{status=ok} in fleet mode).",
+)
+obs_metrics.describe(
+    "serve_shard_latency_seconds",
+    "End-to-end latency of requests resolved per shard.",
+)
+obs_metrics.describe(
+    "shard_telemetry_frames_total",
+    "Telemetry frames merged from shard children into the parent "
+    "registry/journal.",
+)
+obs_metrics.describe(
+    "shard_telemetry_errors_total",
+    "Telemetry frames dropped because their snapshot failed to merge "
+    "(malformed series/buckets).",
+)
 
 
 class _ShardSlot:
@@ -144,6 +173,10 @@ class FleetService:
         self.respawn_total = 0
         self.requeued_total = 0
         self.tenant_shed: Dict[str, int] = {}
+        # per-shard completion tallies (S6: loadgen/bench per-shard rows)
+        self.per_shard: Dict[int, Dict[str, float]] = {}
+        self.telemetry_frames = 0
+        self.telemetry_errors = 0
         if spawn:
             for slot in self._slots:
                 self._spawn_slot(slot)
@@ -239,11 +272,18 @@ class FleetService:
             self._dispatch(self.clock())
             done += self._enforce_inflight_deadlines()
             obs_metrics.set_gauge("serve_queue_depth", len(self.queue))
+            mono = time.monotonic()
             for slot in self._slots:
                 obs_metrics.set_gauge(
                     "serve_shard_inflight", slot.shard.inflight(),
                     shard=str(slot.shard.shard_id),
                 )
+                if slot.state == "up" and slot.shard.last_pong:
+                    obs_metrics.set_gauge(
+                        "serve_shard_last_pong_age_seconds",
+                        max(0.0, mono - slot.shard.last_pong),
+                        shard=str(slot.shard.shard_id),
+                    )
         return done
 
     def _harvest(self) -> int:
@@ -253,6 +293,9 @@ class FleetService:
         done = 0
         for slot in self._slots:
             for msg in slot.shard.poll():
+                if msg.get("op") == "telemetry":
+                    self._merge_telemetry(slot, msg)
+                    continue
                 req = slot.shard.lanes.pop(msg.get("lane"), None)
                 if req is None:
                     continue  # already expired/requeued; ticket is done
@@ -260,9 +303,43 @@ class FleetService:
                 self._resolve_solved(
                     req, row, msg.get("iterations"),
                     shard=slot.shard.shard_id, child_slot=msg.get("slot"),
+                    journey=msg.get("journey"),
                 )
                 done += 1
         return done
+
+    def _merge_telemetry(self, slot: _ShardSlot, msg: dict) -> None:
+        """Fold one child telemetry frame into the parent's registry and
+        journal. Metric deltas merge under a ``shard`` label AND into the
+        label-free aggregate (`MetricsRegistry.merge`), so fleet totals
+        equal the sum of per-shard series by construction; journal
+        records re-emit verbatim with shard provenance. A malformed
+        frame is counted and dropped — telemetry must never take the
+        pump loop down."""
+        shard_id = slot.shard.shard_id
+        try:
+            obs_metrics.get_registry().merge(
+                msg.get("metrics") or {}, shard=str(shard_id)
+            )
+        except Exception as e:
+            self.telemetry_errors += 1
+            obs_metrics.inc(
+                "shard_telemetry_errors_total", shard=str(shard_id)
+            )
+            get_tracer().event(
+                "shard_telemetry_error", shard=shard_id,
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+            return
+        self.telemetry_frames += 1
+        obs_metrics.inc("shard_telemetry_frames_total", shard=str(shard_id))
+        emit = getattr(get_tracer(), "_emit", None)
+        if emit is not None:
+            for rec in msg.get("journal") or ():
+                if isinstance(rec, dict):
+                    rec.setdefault("shard", shard_id)
+                    rec["forwarded"] = True
+                    emit(rec)
 
     def _supervise(self) -> None:
         mono = time.monotonic()
@@ -518,6 +595,43 @@ class FleetService:
                 for slot in self._slots
             }
 
+    def health(self) -> dict:
+        """Liveness summary for the `/healthz` endpoint: overall ``ok``
+        is False while ANY shard is down (crashed, wedge-killed, or
+        backing off before its respawn) — the exporter maps that to a
+        non-200 so an external prober sees a degraded fleet the same
+        cycle supervision does. Ages are on the real monotonic clock,
+        the same one supervision runs on."""
+        with self._lock:
+            mono = time.monotonic()
+            shards: Dict[str, dict] = {}
+            ok = True
+            for slot in self._slots:
+                sh = slot.shard
+                up = slot.state == "up"
+                entry: Dict[str, Any] = {
+                    "up": up,
+                    "inflight": sh.inflight(),
+                    "respawns": slot.respawns,
+                    "backoff_s": slot.backoff,
+                    "last_pong_age_s": (
+                        round(max(0.0, mono - sh.last_pong), 6)
+                        if up and sh.last_pong else None
+                    ),
+                }
+                if not up:
+                    ok = False
+                    entry["respawn_in_s"] = round(
+                        max(0.0, slot.respawn_at - mono), 6
+                    )
+                shards[str(sh.shard_id)] = entry
+            return {
+                "ok": ok,
+                "queue_depth": len(self.queue),
+                "inflight": self._inflight(),
+                "shards": shards,
+            }
+
     # -- completions ---------------------------------------------------
     def _finish_extra(self, req) -> dict:
         return {"requeues": req.requeues} if req.requeues else {}
@@ -541,11 +655,22 @@ class FleetService:
         ))
 
     def _resolve_solved(
-        self, req, row, iterations, *, shard: int, child_slot
+        self, req, row, iterations, *, shard: int, child_slot, journey=None
     ) -> None:
         self.completed += 1
         now = self.clock()
         latency = now - req.submitted_at
+        ps = self.per_shard.setdefault(
+            int(shard), {"completed": 0, "latency_sum": 0.0, "iterations": 0}
+        )
+        ps["completed"] += 1
+        ps["latency_sum"] += latency
+        ps["iterations"] += int(iterations or 0)
+        obs_metrics.inc("serve_shard_requests_total", shard=str(shard))
+        obs_metrics.observe(
+            "serve_shard_latency_seconds", latency, buckets=LATENCY_BUCKETS,
+            shard=str(shard),
+        )
         verdicts = obs_health.classify_solution(row)
         verdict = verdicts[0].verdict if verdicts else "healthy"
         result = SolveResult(
@@ -568,20 +693,48 @@ class FleetService:
             latency_s=latency, iterations=iterations, shard=shard,
         )
         if req.journey is not None:
-            # one cross-process segment: dispatch -> result arrival (the
-            # child's chunk loop is not individually observable from
-            # here, and pipe transfer is honestly part of compute).
             # started_at re-stamps on every dispatch, so a requeued
-            # lane's segment covers only the attempt that answered
+            # lane's marks cover only the attempt that answered
             start = req.started_at
             if start is None:
                 start = req.journey.marks.get("slot", now)
-            req.journey.note_chunk(
-                start, now, 0, int(iterations or 0),
-                int(child_slot) if child_slot is not None else -1,
-                shard=shard,
-            )
-            req.journey.marks["compute_end"] = now
+            marks = (journey or {}).get("marks") or {}
+            if marks.get("compute_end") is not None:
+                # shard-aware attribution: the child's chunk-loop marks
+                # arrive as seconds relative to ITS receipt of the solve
+                # op; re-anchor them on the dispatch stamp and clamp to
+                # arrival so the boundary order (and the exact phase-sum
+                # contract) survives clock domains — including a fake
+                # service clock, where everything clamps to `now` and
+                # respond_s absorbs the whole segment
+                def _at(rel) -> float:
+                    return min(start + float(rel), now)
+
+                if "first_chunk" in marks:
+                    req.journey.mark("first_chunk", _at(marks["first_chunk"]))
+                for c in (journey or {}).get("chunks") or ():
+                    try:
+                        r0, r1, it0, it1, cslot = c
+                    except (TypeError, ValueError):
+                        continue
+                    req.journey.note_chunk(
+                        _at(r0), _at(r1), int(it0), int(it1), int(cslot),
+                        shard=shard,
+                    )
+                req.journey.marks["compute_end"] = _at(marks["compute_end"])
+                if "harvest_end" in marks:
+                    req.journey.mark("harvest_end", _at(marks["harvest_end"]))
+            else:
+                # child ran without --reqtrace: one cross-process segment,
+                # dispatch -> result arrival (pipe transfer is honestly
+                # part of compute)
+                req.journey.note_chunk(
+                    start, now, 0, int(iterations or 0),
+                    int(child_slot) if child_slot is not None else -1,
+                    shard=shard,
+                )
+                req.journey.marks["compute_end"] = now
+            req.journey.shard = int(shard)
             req.journey.finish(
                 "complete", verdict=verdict, iterations=iterations,
                 now=now, **self._finish_extra(req),
@@ -668,6 +821,25 @@ class FleetService:
                 "respawns": self.respawn_total,
                 "requeued_lanes": self.requeued_total,
                 "tenant_shed": dict(self.tenant_shed),
+                "telemetry_frames": self.telemetry_frames,
+                "telemetry_errors": self.telemetry_errors,
+                "per_shard": {
+                    str(k): {
+                        "completed": int(v["completed"]),
+                        "iterations": int(v["iterations"]),
+                        "latency_mean": (
+                            v["latency_sum"] / v["completed"]
+                            if v["completed"] else None
+                        ),
+                        "latency_p95": obs_metrics.histogram_quantile(
+                            "serve_shard_latency_seconds", 0.95, shard=str(k)
+                        ),
+                        "ping_p95": obs_metrics.histogram_quantile(
+                            "serve_shard_ping_seconds", 0.95, shard=str(k)
+                        ),
+                    }
+                    for k, v in sorted(self.per_shard.items())
+                },
             }
             if self.cache is not None:
                 out["cache"] = self.cache.stats()
@@ -691,6 +863,7 @@ def make_dense_fleet(
     tenants: Optional[Dict[str, TenantConfig]] = None,
     clock=time.monotonic,
     reqtrace: bool = False,
+    telemetry: bool = False,
     stderr_dir: Optional[str] = None,
     spawn: bool = True,
     **fleet_kw,
@@ -701,7 +874,12 @@ def make_dense_fleet(
     enough (`parallel.mesh.shard_device_env`); on single-device hosts
     they are plain subprocess crash domains sharing the device.
     `fleet_kw` passes through to `FleetService` (heartbeats, backoff,
-    tenants...); solver options ride `fleet_kw.pop('solver_kw')`."""
+    tenants...); solver options ride `fleet_kw.pop('solver_kw')`.
+    ``telemetry=True`` spawns children with ``--telemetry`` (metrics +
+    journal deltas ride the heartbeat back into the parent registry);
+    ``reqtrace=True`` additionally makes children attach chunk-loop
+    journey marks to result frames. Both off by default and
+    bitwise-neutral for solve results."""
     import os
 
     from ..parallel.mesh import shard_device_env
@@ -719,6 +897,8 @@ def make_dense_fleet(
                 os.path.join(stderr_dir, f"shard{i}.stderr.log")
                 if stderr_dir else None
             ),
+            telemetry=telemetry,
+            reqtrace=reqtrace,
         )
         for i in range(n_shards)
     ]

@@ -40,7 +40,22 @@ Frames child -> parent::
 
     {"op": "pong", "seq": n}
     {"op": "result", "lane": id, "slot": s, "iterations": k,
-     "row": <row>}
+     "row": <row>, "journey": <marks>?}
+    {"op": "telemetry", "shard": k, "seq": n,
+     "metrics": <snapshot delta>, "journal": [<records>]}
+
+The ``telemetry`` frame (child spawned with ``--telemetry 1``; off by
+default) piggybacks on the heartbeat: each ping answered also ships the
+child registry's `snapshot_delta` since the previous ship plus any
+journal records buffered since — the parent folds the delta into its
+own registry under a ``shard`` label (`MetricsRegistry.merge`) and
+re-emits the records with shard provenance. Deltas, not absolutes, so a
+respawned child restarting from zero can only ever ADD to fleet
+aggregates. With ``--reqtrace 1`` each result frame also carries the
+lane's chunk-loop journey marks (seconds relative to the child's
+receipt of the solve op), which the parent maps into the request's
+`obs.reqtrace` journey so compute time is attributed to the shard that
+did the work.
 
 The ``fault`` op is the fault-injection surface `tests/test_serve_fleet.py`
 and the loadgen chaos leg drive: ``exit`` dies immediately (os._exit),
@@ -71,6 +86,14 @@ DIE_ON_START_ENV = "DISPATCHES_TPU_SHARD_DIE_ON_START"
 DEVICE_ENV = "DISPATCHES_TPU_SHARD_DEVICE"
 
 _MAX_FRAME = 256 * 1024 * 1024  # refuse absurd lengths: torn stream, not data
+
+# heartbeat round-trip buckets (serve_shard_ping_seconds): pings cross
+# two pipes and a thread wakeup, so sub-ms to low-seconds is the range;
+# anything near the heartbeat timeout is the wedge-detection signal
+PING_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5,
+)
 
 #: child bootstrap: load THIS file as a standalone module (stdlib-only
 #: top level) instead of ``-m dispatches_tpu.serve.shard`` — the ``-m``
@@ -184,6 +207,66 @@ def decode_row(spec: dict):
 # the child worker
 
 
+class _LaneJourneys:
+    """Child half of the shard-aware journey: a `SlotEngine.observer`
+    (chunk_begin / cold_end / compute_end / harvest_end duck type) whose
+    tokens are lane ids, recording each lane's chunk-loop marks as
+    seconds RELATIVE to the child's receipt of its solve op. Relative,
+    because the parent's service clock may be fake (tests) or skewed —
+    the parent re-anchors the marks onto its own dispatch stamp and
+    clamps to the result-arrival stamp, so phase sums stay exact."""
+
+    __slots__ = ("data", "_chunk_t")
+
+    def __init__(self):
+        self.data: Dict[Any, dict] = {}
+        self._chunk_t = 0.0
+
+    def begin(self, lane) -> None:
+        self.data[lane] = {"t0": time.monotonic(), "marks": {}, "chunks": []}
+
+    def forget(self, lane) -> None:
+        self.data.pop(lane, None)
+
+    def pop(self, lane) -> Optional[dict]:
+        d = self.data.pop(lane, None)
+        if d is None:
+            return None
+        return {"marks": d["marks"], "chunks": d["chunks"]}
+
+    # -- SlotEngine observer hooks --
+    def chunk_begin(self, tokens) -> None:
+        self._chunk_t = time.monotonic()
+
+    def cold_end(self, tokens, fresh) -> None:
+        t = time.monotonic()
+        for tok, f in zip(tokens, fresh):
+            d = self.data.get(tok) if tok is not None else None
+            if f and d is not None:
+                d["marks"].setdefault("first_chunk", t - d["t0"])
+
+    def compute_end(self, tokens, it0, it1) -> None:
+        t = time.monotonic()
+        for i, tok in enumerate(tokens):
+            d = self.data.get(tok) if tok is not None else None
+            if d is None:
+                continue
+            d["marks"].setdefault("first_chunk", self._chunk_t - d["t0"])
+            start = (
+                self._chunk_t - d["t0"] if d["chunks"]
+                else d["marks"]["first_chunk"]
+            )
+            d["chunks"].append([start, t - d["t0"], int(it0[i]), int(it1[i]), i])
+            d["marks"]["compute_end"] = t - d["t0"]  # rolls forward per chunk
+
+    def harvest_end(self, tokens) -> None:
+        t = time.monotonic()
+        for tok in tokens:
+            d = self.data.get(tok) if tok is not None else None
+            if d is not None:
+                d["marks"].setdefault("harvest_end", t - d["t0"])
+
+
 def worker_main(argv: Optional[List[str]] = None) -> int:
     """Entry point of ``python -m dispatches_tpu.serve.shard``."""
     import argparse
@@ -195,6 +278,10 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--x64", type=int, default=1)
     ap.add_argument("--solver-kw", default="{}",
                     help="JSON dict forwarded to solve_lp_partial")
+    ap.add_argument("--telemetry", type=int, default=0,
+                    help="ship metrics/journal deltas on heartbeat pongs")
+    ap.add_argument("--reqtrace", type=int, default=0,
+                    help="attach chunk-loop journey marks to result frames")
     args = ap.parse_args(argv)
 
     if os.environ.get(DIE_ON_START_ENV) == "1":
@@ -209,6 +296,10 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     out_lock = threading.Lock()
     inbox: Queue = Queue()
     fault = {"hang": False, "nan": False}
+    # telemetry shipper, installed by the main loop once obs imports are
+    # safe (the reader starts before jax; importing the package here
+    # would stall the very pings this thread exists to answer)
+    telem = {"ship": None}
 
     def _send(obj: dict) -> None:
         with out_lock:
@@ -226,6 +317,12 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
             if op == "ping":
                 if not fault["hang"]:
                     _send({"op": "pong", "seq": msg.get("seq")})
+                    ship = telem["ship"]
+                    if ship is not None:
+                        try:
+                            ship()
+                        except Exception:
+                            pass  # telemetry must never take the shard down
             elif op == "fault":
                 mode = msg.get("mode")
                 if mode == "exit":
@@ -256,6 +353,61 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
         args.bucket, chunk_iters=args.chunk_iters, **solver_kw
     )
 
+    journeys: Optional[_LaneJourneys] = None
+    if args.reqtrace:
+        journeys = _LaneJourneys()
+        engine.observer = journeys
+
+    tracer = None
+    if args.telemetry:
+        from dispatches_tpu.obs import journal as obs_journal
+        from dispatches_tpu.obs import metrics as obs_metrics
+
+        # in-memory tracer: child-side journal records (solve_event
+        # health verdicts, watchdog hangs, ...) buffer here and ride the
+        # telemetry frames to the parent journal with shard provenance
+        tracer = obs_journal.Tracer()
+        obs_journal.set_tracer(tracer)
+        ship_state = {"snap": {}, "seq": 0, "sent": 0}
+
+        def _ship() -> None:
+            snap = obs_metrics.snapshot()
+            delta = obs_metrics.snapshot_delta(ship_state["snap"], snap)
+            with tracer._lock:
+                batch = list(tracer.events[ship_state["sent"]:])
+                ship_state["sent"] = len(tracer.events)
+                if ship_state["sent"] > 4096:  # bound the buffer's growth
+                    del tracer.events[:ship_state["sent"]]
+                    ship_state["sent"] = 0
+            records = []
+            for rec in batch:
+                if rec.get("kind") == "manifest":
+                    # the parent journal already has ITS manifest; the
+                    # child's becomes a provenance event (device, run id)
+                    rec = {
+                        "kind": "event", "name": "shard_manifest",
+                        "ts": rec.get("ts"), "run_id": rec.get("run_id"),
+                        "device_kind": rec.get("device_kind"),
+                        "platform": rec.get("platform"),
+                        "device_count": rec.get("device_count"),
+                    }
+                records.append(rec)
+            changed = (
+                bool(delta["counters"]) or bool(delta["histograms"])
+                or delta["gauges"] != (ship_state["snap"].get("gauges") or {})
+            )
+            if not records and not changed:
+                return  # idle shard: nothing to say this heartbeat
+            ship_state["snap"] = snap
+            ship_state["seq"] += 1
+            _send({
+                "op": "telemetry", "shard": args.shard_id,
+                "seq": ship_state["seq"], "metrics": delta,
+                "journal": records,
+            })
+
+        telem["ship"] = _ship
+
     pending: List[dict] = []
     slots: Dict[Any, int] = {}  # lane id -> engine slot, for result frames
     while True:
@@ -282,6 +434,8 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
             op = msg.get("op")
             if op == "solve":
                 pending.append(msg)
+                if journeys is not None:
+                    journeys.begin(msg.get("lane"))  # receipt anchors marks
             elif op == "cancel":
                 # fully handled here: the lane leaves pending/engine, so
                 # no result frame can be emitted for it afterwards (a
@@ -290,6 +444,8 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 lane = msg.get("lane")
                 pending = [m for m in pending if m.get("lane") != lane]
                 slots.pop(lane, None)
+                if journeys is not None:
+                    journeys.forget(lane)
                 if lane in engine.active():
                     engine.evict(lane)
         if stop:
@@ -306,13 +462,26 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                     if np.asarray(leaf).dtype.kind == "f" else leaf
                     for leaf in row
                 ))
-            _send({
+            if tracer is not None:
+                # child-side health verdict with shard provenance; rides
+                # the next telemetry frame into the parent journal
+                tracer.solve_event(
+                    "shard_engine", row, lane=lane,
+                    iterations=stats.get("iterations"),
+                    shard=args.shard_id,
+                )
+            frame = {
                 "op": "result",
                 "lane": lane,
                 "slot": slot,
                 "iterations": stats.get("iterations"),
                 "row": encode_row(row),
-            })
+            }
+            if journeys is not None:
+                j = journeys.pop(lane)
+                if j is not None:
+                    frame["journey"] = j
+            _send(frame)
 
 
 # ---------------------------------------------------------------------------
@@ -340,6 +509,8 @@ class ShardProcess:
         device_env: Optional[Dict[str, str]] = None,
         extra_env: Optional[Dict[str, str]] = None,
         stderr_path: Optional[str] = None,
+        telemetry: bool = False,
+        reqtrace: bool = False,
     ):
         self.shard_id = int(shard_id)
         self.bucket = int(bucket)
@@ -348,6 +519,8 @@ class ShardProcess:
         self.device_env = dict(device_env or {})
         self.extra_env = dict(extra_env or {})
         self.stderr_path = stderr_path
+        self.telemetry = bool(telemetry)
+        self.reqtrace = bool(reqtrace)
         self.proc: Optional[subprocess.Popen] = None
         self.lanes: Dict[Any, Any] = {}  # lane id -> SolveRequest
         self.last_ping: Optional[float] = None
@@ -358,6 +531,7 @@ class ShardProcess:
         self._eof = False
         self._send_lock = threading.Lock()
         self._ping_seq = 0
+        self._ping_sent: Dict[int, float] = {}  # seq -> stamp, until ponged
         self._stderr_fh = None
 
     # -- lifecycle -----------------------------------------------------
@@ -377,6 +551,8 @@ class ShardProcess:
             "--shard-id", str(self.shard_id),
             "--x64", "1" if jax.config.jax_enable_x64 else "0",
             "--solver-kw", json.dumps(self.solver_kw),
+            "--telemetry", "1" if self.telemetry else "0",
+            "--reqtrace", "1" if self.reqtrace else "0",
         ]
         env = dict(os.environ)
         # the child must import dispatches_tpu no matter the parent's cwd
@@ -401,6 +577,7 @@ class ShardProcess:
         self.spawned_at = now
         self.last_ping = None
         self.last_pong = now  # spawn grace: no wedge verdict before a ping
+        self._ping_sent.clear()  # stale seqs must not match a fresh child
         threading.Thread(
             target=self._reader, args=(self.proc, self._results),
             name=f"shard-{self.shard_id}-reader", daemon=True,
@@ -415,7 +592,19 @@ class ShardProcess:
                 return
             if msg.get("op") == "pong":
                 if proc is self.proc:
-                    self.last_pong = time.monotonic()
+                    now = time.monotonic()
+                    self.last_pong = now
+                    sent = self._ping_sent.pop(msg.get("seq"), None)
+                    if sent is not None:
+                        # lazy import: the CHILD executes this module's
+                        # top level standalone and must stay stdlib-only
+                        from ..obs import metrics as obs_metrics
+
+                        obs_metrics.observe(
+                            "serve_shard_ping_seconds", now - sent,
+                            buckets=PING_BUCKETS,
+                            shard=str(self.shard_id),
+                        )
             else:
                 results.put(msg)
 
@@ -483,8 +672,11 @@ class ShardProcess:
         # last_pong < last_ping forever — supervision then never re-pings
         # and kills a healthy shard when the wedge timer expires
         stamp = time.monotonic()
+        self._ping_sent[self._ping_seq] = stamp
         if self._send({"op": "ping", "seq": self._ping_seq}):
             self.last_ping = stamp
+        else:
+            self._ping_sent.pop(self._ping_seq, None)
 
     def poll(self) -> List[dict]:
         """Drain every result frame received so far (non-blocking)."""

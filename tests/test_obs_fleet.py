@@ -1,0 +1,411 @@
+"""Fleet telemetry plane: cross-process metrics merge semantics
+(`MetricsRegistry.merge` / `snapshot_delta`), the scrape endpoint
+(`obs.exporter.TelemetryExporter`), equivalence of `tools/fleet_top.py`'s
+stdlib-only mirrors with the library implementations, and the
+conservation contract against REAL shard children under kill_shard
+chaos. The child-spawning test is kept to one (a subprocess jax import
+each); everything else runs on plain registries."""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.obs.exporter import TelemetryExporter
+from dispatches_tpu.obs.journal import Tracer, use_tracer
+from dispatches_tpu.obs.metrics import (
+    MetricsRegistry,
+    parse_series,
+    series_name,
+    snapshot_delta,
+)
+
+
+def _lp(seed, n=6, m=3, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    return LPData(
+        jnp.asarray(A, dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+# ---------------------------------------------------------------------
+# parse_series: the inverse of series_name
+# ---------------------------------------------------------------------
+class TestParseSeries:
+    def test_round_trips_plain_series(self):
+        s = series_name("solves_total", {"solver": "lp", "entry": "d8"})
+        assert parse_series(s) == (
+            "solves_total", {"entry": "d8", "solver": "lp"}
+        )
+
+    def test_bare_name(self):
+        assert parse_series("up") == ("up", {})
+
+    def test_round_trips_escaped_label_values(self):
+        # shard ids are operator-controlled strings: quotes, backslashes
+        # and newlines must survive series_name -> parse_series exactly
+        for evil in ('we"ird', "back\\slash", "new\nline", 'all\\"\n'):
+            s = series_name("m", {"shard": evil})
+            assert parse_series(s) == ("m", {"shard": evil})
+
+    @pytest.mark.parametrize("bad", [
+        'm{shard="0"', "m{shard=0}", 'm{shard="0',
+        'm{shard="0"extra="1"}',
+    ])
+    def test_malformed_raises(self, bad):
+        with pytest.raises(ValueError):
+            parse_series(bad)
+
+
+# ---------------------------------------------------------------------
+# snapshot_delta: what a child ships each heartbeat
+# ---------------------------------------------------------------------
+class TestSnapshotDelta:
+    def test_counters_ship_nonzero_deltas_only(self):
+        reg = MetricsRegistry()
+        reg.inc("a", 3.0)
+        reg.inc("b", 1.0)
+        before = reg.snapshot()
+        reg.inc("a", 2.0)
+        d = snapshot_delta(before, reg.snapshot())
+        assert d["counters"] == {"a": 2.0}
+
+    def test_histograms_ship_bucket_deltas(self):
+        reg = MetricsRegistry()
+        reg.observe("h", 0.003, buckets=(0.001, 0.01))
+        before = reg.snapshot()
+        reg.observe("h", 0.0005, buckets=(0.001, 0.01))
+        d = snapshot_delta(before, reg.snapshot())
+        h = d["histograms"]["h"]
+        assert h["count"] == 1
+        assert h["buckets"] == {"0.001": 1, "0.01": 0, "+Inf": 0}
+
+    def test_gauges_are_absolute(self):
+        reg = MetricsRegistry()
+        reg.set_gauge("g", 5.0)
+        before = reg.snapshot()
+        reg.set_gauge("g", 2.0)
+        d = snapshot_delta(before, reg.snapshot())
+        assert d["gauges"] == {"g": 2.0}
+
+
+# ---------------------------------------------------------------------
+# MetricsRegistry.merge: the parent side
+# ---------------------------------------------------------------------
+class TestMerge:
+    def test_counters_fold_into_labeled_and_aggregate(self):
+        reg = MetricsRegistry()
+        reg.merge({"counters": {"solves_total": 3.0}}, shard="0")
+        reg.merge({"counters": {"solves_total": 4.0}}, shard="1")
+        c = reg.snapshot()["counters"]
+        assert c['solves_total{shard="0"}'] == 3.0
+        assert c['solves_total{shard="1"}'] == 4.0
+        # conservation by construction: aggregate == sum of shard series
+        assert c["solves_total"] == 7.0
+
+    def test_monotonic_across_respawn(self):
+        # a respawned child ships from a zero baseline: its deltas can
+        # only ADD to the parent series, never reset them
+        reg = MetricsRegistry()
+        reg.merge({"counters": {"solves_total": 5.0}}, shard="0")
+        seen = [reg.snapshot()["counters"]['solves_total{shard="0"}']]
+        # child 0 dies; its replacement counts from scratch
+        for delta in (1.0, 2.0):
+            reg.merge({"counters": {"solves_total": delta}}, shard="0")
+            seen.append(reg.snapshot()["counters"]['solves_total{shard="0"}'])
+        assert seen == sorted(seen) == [5.0, 6.0, 8.0]
+        assert reg.snapshot()["counters"]["solves_total"] == 8.0
+
+    def test_histogram_bucket_wise_merge(self):
+        reg = MetricsRegistry()
+        reg.observe("lat", 0.003, buckets=(0.001, 0.01))
+        snap = {"histograms": {"lat": {
+            "count": 3, "sum": 0.012,
+            "buckets": {"0.001": 1, "0.01": 2, "+Inf": 0},
+        }}}
+        reg.merge(snap, shard="0")
+        h = reg.snapshot()["histograms"]
+        # aggregate got the child's counts element-wise on the same ladder
+        assert h["lat"]["buckets"] == {"0.001": 1, "0.01": 3, "+Inf": 0}
+        assert h["lat"]["count"] == 4
+        assert h["lat"]["sum"] == pytest.approx(0.015)
+        assert h['lat{shard="0"}']["count"] == 3
+
+    def test_histogram_mismatched_ladder_rebuckets(self):
+        reg = MetricsRegistry()
+        reg.observe("lat", 0.5, buckets=(0.1, 1.0))
+        # child used a finer ladder: counts land at the first parent
+        # bound that contains each child bound
+        snap = {"histograms": {"lat": {
+            "count": 2, "sum": 0.06,
+            "buckets": {"0.05": 1, "0.2": 1, "+Inf": 0},
+        }}}
+        reg.merge(snap, shard="0")
+        agg = reg.snapshot()["histograms"]["lat"]
+        assert agg["count"] == 3
+        assert agg["buckets"]["0.1"] == 1  # the 0.05-bound observation
+        assert agg["buckets"]["1.0"] == 2  # 0.5 parent + 0.2 child
+
+    def test_gauges_labeled_only_last_write_wins(self):
+        reg = MetricsRegistry()
+        reg.set_gauge("depth", 9.0)  # the parent's own series
+        reg.merge({"gauges": {"depth": 3.0}}, shard="0")
+        reg.merge({"gauges": {"depth": 1.0}}, shard="0")
+        g = reg.snapshot()["gauges"]
+        assert g['depth{shard="0"}'] == 1.0
+        assert g["depth"] == 9.0  # absolute values never sum into it
+
+    def test_label_escaping_round_trips_through_merge(self):
+        evil = 'we"ird\\id\nx'
+        reg = MetricsRegistry()
+        reg.merge({"counters": {"solves_total": 2.0}}, shard=evil)
+        series = [
+            s for s in reg.snapshot()["counters"] if s != "solves_total"
+        ]
+        assert len(series) == 1
+        name, labels = parse_series(series[0])
+        assert (name, labels) == ("solves_total", {"shard": evil})
+        # and the Prometheus exposition still parses line-wise
+        assert '\\n' in reg.render_prometheus()
+
+    def test_labeled_child_series_keep_their_labels(self):
+        reg = MetricsRegistry()
+        reg.merge(
+            {"counters": {'solves_total{solver="lp"}': 2.0}}, shard="1"
+        )
+        c = reg.snapshot()["counters"]
+        assert c['solves_total{shard="1",solver="lp"}'] == 2.0
+        assert c['solves_total{solver="lp"}'] == 2.0
+
+    def test_empty_snapshot_merges_nothing(self):
+        reg = MetricsRegistry()
+        assert reg.merge({}, shard="0") == 0
+        assert reg.snapshot()["counters"] == {}
+
+
+# ---------------------------------------------------------------------
+# exporter
+# ---------------------------------------------------------------------
+class TestExporter:
+    def test_handle_path_routes(self):
+        reg = MetricsRegistry()
+        reg.inc("solves_total", 2.0, shard="0")
+        exp = TelemetryExporter(
+            registry=reg,
+            health_fn=lambda: {"ok": True, "shards": {}},
+            slo_fn=lambda: {"worst_burn_rate": 0.0},
+        )
+        status, ctype, body = exp.handle_path("/metrics")
+        assert status == 200 and "0.0.4" in ctype
+        assert 'solves_total{shard="0"} 2' in body.decode()
+        status, _, body = exp.handle_path("/healthz")
+        assert status == 200 and json.loads(body)["ok"] is True
+        status, _, body = exp.handle_path("/slo")
+        assert status == 200 and "worst_burn_rate" in json.loads(body)
+        status, _, body = exp.handle_path("/snapshot")
+        assert json.loads(body) == reg.snapshot()
+        assert exp.handle_path("/nope")[0] == 404
+
+    def test_healthz_non_200_when_not_ok(self):
+        exp = TelemetryExporter(
+            health_fn=lambda: {"ok": False, "shards": {"0": {"up": False}}}
+        )
+        status, _, body = exp.handle_path("/healthz")
+        assert status == 503
+        assert json.loads(body)["shards"]["0"]["up"] is False
+
+    def test_broken_health_fn_returns_500_not_crash(self):
+        def boom():
+            raise RuntimeError("no")
+
+        exp = TelemetryExporter(health_fn=boom)
+        status, _, body = exp.handle_path("/healthz")
+        assert status == 500 and "RuntimeError" in json.loads(body)["error"]
+
+    def test_real_socket_serves_and_stops(self):
+        reg = MetricsRegistry()
+        reg.inc("up_total")
+        ok = {"ok": True}
+        with TelemetryExporter(0, registry=reg, health_fn=lambda: ok) as exp:
+            assert exp.port != 0  # ephemeral port was bound
+            with urllib.request.urlopen(exp.url("/metrics"), timeout=5) as r:
+                assert r.status == 200 and b"up_total 1" in r.read()
+            ok["ok"] = False
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(exp.url("/healthz"), timeout=5)
+            assert ei.value.code == 503
+        exp.stop()  # idempotent after the context manager
+
+
+# ---------------------------------------------------------------------
+# fleet_top's stdlib mirrors must track the library implementations
+# ---------------------------------------------------------------------
+class TestFleetTopEquivalence:
+    @pytest.fixture()
+    def fleet_top(self):
+        import os
+        import sys
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import fleet_top
+
+        return fleet_top
+
+    def test_parse_series_matches(self, fleet_top):
+        cases = [
+            "up",
+            series_name("m", {"shard": "0", "entry": "d8"}),
+            series_name("m", {"shard": 'we"ird\\id\nx'}),
+        ]
+        for s in cases:
+            assert fleet_top.parse_series(s) == parse_series(s)
+
+    def test_hist_quantile_matches(self, fleet_top):
+        reg = MetricsRegistry()
+        vals = [0.0004, 0.003, 0.003, 0.04, 0.2]
+        for v in vals:
+            reg.observe("lat", v, buckets=(0.001, 0.01, 0.1), shard="0")
+        snap = reg.snapshot()["histograms"]['lat{shard="0"}']
+        for q in (0.5, 0.95, 0.99):
+            assert fleet_top.hist_quantile(snap, q) == pytest.approx(
+                reg.histogram_quantile("lat", q, shard="0")
+            )
+
+    def test_self_check_passes(self, fleet_top):
+        assert fleet_top.self_check() == 0
+
+
+# ---------------------------------------------------------------------
+# real shard children: conservation + journeys under kill_shard chaos
+# ---------------------------------------------------------------------
+class TestFleetTelemetryChildren:
+    def test_conservation_and_journeys_under_chaos(self):
+        import time
+
+        from dispatches_tpu.obs import metrics as obs_metrics
+        from dispatches_tpu.serve import make_dense_fleet
+
+        obs_metrics.reset_metrics()
+        before = obs_metrics.snapshot()["counters"]
+        tracer = Tracer()  # in-memory: journeys land in .events
+        with use_tracer(tracer):
+            fleet = make_dense_fleet(
+                2, 2, chunk_iters=2, cache_size=None,
+                respawn_backoff=0.05, solver_kw={"max_iter": 120},
+                telemetry=True, reqtrace=True, heartbeat_every=0.05,
+            )
+            try:
+                fleet.start()
+                tickets = [fleet.submit(_lp(400 + s)) for s in range(8)]
+                victim = None
+                t0 = time.monotonic()
+                while victim is None and time.monotonic() - t0 < 60.0:
+                    for sid, st in fleet.shard_states().items():
+                        if st["state"] == "up" and st["inflight"] > 0:
+                            victim = sid
+                            break
+                    time.sleep(0.005)
+                assert victim is not None
+                fleet.kill_shard(victim)
+                results = [t.result(timeout=240.0) for t in tickets]
+                assert all(r.verdict in ("healthy", "slow") for r in results)
+                assert fleet.respawn_total >= 1
+
+                # wait for the post-respawn heartbeats to ship the final
+                # engine-counter deltas from BOTH shard ids
+                deadline = time.monotonic() + 30.0
+                labeled = {}
+                while time.monotonic() < deadline:
+                    labeled = self._engine_deltas(before)
+                    if {"0", "1"} <= {
+                        s for m in labeled.values() for s in m
+                    }:
+                        break
+                    time.sleep(0.02)
+                after = obs_metrics.snapshot()["counters"]
+                labeled = self._engine_deltas(before)
+                assert {"0", "1"} <= {
+                    s for m in labeled.values() for s in m
+                }, f"missing a shard in {labeled}"
+                # conservation: label-free aggregate == sum of per-shard
+                # series, exactly, for every merged engine counter
+                for (name, base), per_shard in labeled.items():
+                    series = series_name(name, dict(base))
+                    agg = after.get(series, 0.0) - before.get(series, 0.0)
+                    assert agg == pytest.approx(
+                        sum(per_shard.values()), abs=1e-9
+                    ), name
+
+                # parent-side shard attribution sums to the solved count
+                shard_reqs = sum(
+                    v for s, v in after.items()
+                    if s.startswith("serve_shard_requests_total{")
+                )
+                assert int(shard_reqs) == len(results)
+                # liveness instruments exist for the shards
+                snap = obs_metrics.snapshot()
+                assert any(
+                    s.startswith("serve_shard_ping_seconds{")
+                    for s in snap["histograms"]
+                )
+                assert any(
+                    s.startswith("serve_shard_last_pong_age_seconds{")
+                    for s in snap["gauges"]
+                )
+                assert fleet.health()["ok"] is True
+            finally:
+                fleet.stop(drain=False)
+                fleet.close()
+
+        journeys = [
+            r for r in tracer.events if r.get("kind") == "journey"
+        ]
+        assert len(journeys) == len(tickets)
+        for j in journeys:
+            phases = j["phases"]
+            # exact-sum contract survives the process hop: the child's
+            # re-anchored marks still partition the parent's latency
+            assert sum(phases.values()) == pytest.approx(
+                j["latency_s"], abs=1e-9
+            )
+            assert phases.get("compute_s", 0.0) > 0.0
+            assert j.get("shard") in (0, 1)
+            assert all(c.get("shard") in (0, 1) for c in j["chunks"])
+        # child solve events were forwarded with shard provenance
+        fwd = [
+            r for r in tracer.events
+            if r.get("forwarded") and r.get("kind") == "solve"
+        ]
+        assert fwd and all(r.get("shard") in (0, 1) for r in fwd)
+
+    @staticmethod
+    def _engine_deltas(before):
+        """(name, base-labels) -> {shard: delta} for the child-only
+        engine counters (the fleet parent never bumps these itself)."""
+        from dispatches_tpu.obs import metrics as obs_metrics
+
+        after = obs_metrics.snapshot()["counters"]
+        out = {}
+        for series in after:
+            d = after[series] - before.get(series, 0.0)
+            if d == 0:
+                continue
+            name, labels = parse_series(series)
+            if not name.startswith(("adaptive_", "compile_cache_")):
+                continue
+            shard = labels.pop("shard", None)
+            if shard is not None:
+                key = (name, tuple(sorted(labels.items())))
+                out.setdefault(key, {})[shard] = d
+        return out

@@ -1,0 +1,472 @@
+#!/usr/bin/env python
+"""fleet_top — live terminal view of a serving fleet's merged telemetry.
+
+Renders the per-shard state of a `FleetService` from the fleet telemetry
+plane (docs/observability.md §9): one row per shard with liveness,
+in-flight lanes, request totals + qps, latency/ping p95s, last-pong age,
+respawns, and retired lanes — plus the fleet aggregate row the merge
+invariant guarantees equals the sum of the shards — and, in live mode,
+the current SLO worst burn rates.
+
+Two sources:
+
+- **live**: ``--url http://127.0.0.1:PORT`` polls a
+  `obs.exporter.TelemetryExporter` (``/snapshot`` for the registry,
+  ``/healthz`` for liveness, ``/slo`` for burn rates) every
+  ``--interval`` seconds; qps comes from counter deltas between polls.
+- **offline**: ``--snapshot FILE`` renders one frame from a registry
+  snapshot JSON (an exporter ``/snapshot`` capture, or the ``metrics``
+  field of a journal's close record).
+
+Stdlib-only on purpose (same contract as journal_diff/trace_timeline):
+pointing this at a production fleet must not import jax. The series
+parser and histogram quantile mirror `obs.metrics` exactly —
+`tests/test_obs_fleet.py` holds the two implementations together.
+
+Usage:
+    python tools/fleet_top.py --url http://127.0.0.1:9100
+    python tools/fleet_top.py --url http://127.0.0.1:9100 --once --json
+    python tools/fleet_top.py --snapshot snap.json --once
+    python tools/fleet_top.py --self-check
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# series parsing + histogram quantile (mirrors obs.metrics, stdlib-only)
+
+
+def parse_series(series: str) -> Tuple[str, Dict[str, str]]:
+    """``'name{k="v",...}'`` -> (name, labels), undoing exposition-format
+    escapes — the exact inverse of `obs.metrics.series_name`."""
+    if "{" not in series:
+        return series, {}
+    name, rest = series.split("{", 1)
+    if not rest.endswith("}"):
+        raise ValueError(f"unterminated label block: {series!r}")
+    body = rest[:-1]
+    labels: Dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        eq = body.find("=", i)
+        if eq < 0 or eq + 1 >= n or body[eq + 1] != '"':
+            raise ValueError(f"malformed label pair: {series!r}")
+        key = body[i:eq]
+        j = eq + 2
+        buf: List[str] = []
+        while j < n:
+            c = body[j]
+            if c == "\\" and j + 1 < n:
+                nxt = body[j + 1]
+                buf.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, "\\" + nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        else:
+            raise ValueError(f"unterminated label value: {series!r}")
+        labels[key] = "".join(buf)
+        i = j + 1
+        if i < n:
+            if body[i] != ",":
+                raise ValueError(f"malformed separator: {series!r}")
+            i += 1
+    return name, labels
+
+
+def hist_quantile(h: Dict[str, Any], q: float) -> Optional[float]:
+    """q-quantile of a snapshot histogram dict (Prometheus-style linear
+    interpolation; +Inf observations clamp to the largest finite bound).
+    Mirrors `MetricsRegistry.histogram_quantile`."""
+    count = int(h.get("count") or 0)
+    if not count:
+        return None
+    buckets = sorted(
+        (float("inf") if b == "+Inf" else float(b), int(c))
+        for b, c in (h.get("buckets") or {}).items()
+    )
+    finite = [(b, c) for b, c in buckets if b != float("inf")]
+    rank = q * count
+    cum = 0.0
+    for i, (b, c) in enumerate(finite):
+        prev = cum
+        cum += c
+        if cum >= rank:
+            lo = finite[i - 1][0] if i else 0.0
+            frac = (rank - prev) / c if c else 0.0
+            return lo + (b - lo) * frac
+    return finite[-1][0] if finite else None
+
+
+# ---------------------------------------------------------------------------
+# snapshot -> per-shard rows
+
+
+def _by_shard(
+    snap: Dict[str, Any], kind: str, name: str
+) -> Dict[str, float]:
+    """Sum every `kind` series named `name` per ``shard`` label value."""
+    out: Dict[str, float] = {}
+    for series, v in (snap.get(kind) or {}).items():
+        n, labels = parse_series(series)
+        if n != name or "shard" not in labels:
+            continue
+        val = float(v["count"]) if isinstance(v, dict) else float(v)
+        out[labels["shard"]] = out.get(labels["shard"], 0.0) + val
+    return out
+
+
+def _shard_hist(
+    snap: Dict[str, Any], name: str, shard: str
+) -> Optional[Dict[str, Any]]:
+    for series, h in (snap.get("histograms") or {}).items():
+        n, labels = parse_series(series)
+        if n == name and labels.get("shard") == shard:
+            return h
+    return None
+
+
+def fleet_rows(
+    snap: Dict[str, Any],
+    health: Optional[Dict[str, Any]] = None,
+    prev: Optional[Dict[str, Any]] = None,
+    dt: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """One dict per shard (sorted by id), assembled from the merged
+    registry snapshot + optional /healthz JSON. `prev`/`dt` (previous
+    snapshot and seconds between) turn request counters into qps."""
+    requests = _by_shard(snap, "counters", "serve_shard_requests_total")
+    retired = _by_shard(snap, "counters", "adaptive_lanes_retired_total")
+    respawns = _by_shard(snap, "counters", "shard_respawn_total")
+    inflight = _by_shard(snap, "gauges", "serve_shard_inflight")
+    pong_age = _by_shard(snap, "gauges", "serve_shard_last_pong_age_seconds")
+    up = _by_shard(snap, "gauges", "serve_shard_up")
+    prev_requests = (
+        _by_shard(prev, "counters", "serve_shard_requests_total")
+        if prev else {}
+    )
+    h_shards = (health or {}).get("shards") or {}
+    ids = sorted(
+        set(requests) | set(inflight) | set(up) | set(h_shards)
+        | set(pong_age),
+        key=lambda s: (len(s), s),
+    )
+    rows = []
+    for sid in ids:
+        hs = h_shards.get(sid) or {}
+        lat = _shard_hist(snap, "serve_shard_latency_seconds", sid)
+        ping = _shard_hist(snap, "serve_shard_ping_seconds", sid)
+        qps = None
+        if prev and dt and dt > 0:
+            qps = (requests.get(sid, 0.0) - prev_requests.get(sid, 0.0)) / dt
+        rows.append({
+            "shard": sid,
+            "up": bool(hs.get("up", up.get(sid, 0.0) >= 1.0)),
+            "inflight": int(hs.get("inflight", inflight.get(sid, 0))),
+            "requests": int(requests.get(sid, 0)),
+            "qps": qps,
+            "latency_p95_s": hist_quantile(lat, 0.95) if lat else None,
+            "ping_p95_s": hist_quantile(ping, 0.95) if ping else None,
+            "pong_age_s": (
+                hs.get("last_pong_age_s")
+                if hs.get("last_pong_age_s") is not None
+                else pong_age.get(sid)
+            ),
+            "respawns": int(hs.get("respawns", respawns.get(sid, 0))),
+            "lanes_retired": int(retired.get(sid, 0)),
+        })
+    return rows
+
+
+def aggregate_requests(snap: Dict[str, Any]) -> int:
+    """The label-free fleet aggregate of serve_shard_requests_total —
+    by the merge invariant, equal to the sum of the shard rows."""
+    total = 0.0
+    for series, v in (snap.get("counters") or {}).items():
+        n, labels = parse_series(series)
+        if n == "serve_shard_requests_total" and "shard" not in labels:
+            total += float(v)
+    return int(total)
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+
+def _fmt(v: Any, scale: float = 1.0, unit: str = "", nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    return f"{float(v) * scale:.{nd}f}{unit}"
+
+
+def render(
+    snap: Dict[str, Any],
+    health: Optional[Dict[str, Any]] = None,
+    slo: Optional[Dict[str, Any]] = None,
+    prev: Optional[Dict[str, Any]] = None,
+    dt: Optional[float] = None,
+) -> str:
+    rows = fleet_rows(snap, health, prev, dt)
+    n_down = sum(1 for r in rows if not r["up"])
+    head = [
+        f"fleet_top — {len(rows)} shard(s)"
+        + (f", {n_down} DOWN" if n_down else ""),
+    ]
+    if health:
+        head.append(
+            f"queue {health.get('queue_depth', '-')}"
+            f" | inflight {health.get('inflight', '-')}"
+            f" | ok={health.get('ok')}"
+        )
+    if slo:
+        head.append(f"worst burn {_fmt(slo.get('worst_burn_rate'), nd=2)}")
+    lines = ["  ".join(head)]
+    cols = (
+        "shard", "up", "inflt", "reqs", "qps", "p95 ms", "ping p95 ms",
+        "pong age s", "respawns", "retired",
+    )
+    table = [cols]
+    for r in rows:
+        table.append((
+            r["shard"],
+            "●" if r["up"] else "○ DOWN",
+            str(r["inflight"]),
+            str(r["requests"]),
+            _fmt(r["qps"]),
+            _fmt(r["latency_p95_s"], 1000.0),
+            _fmt(r["ping_p95_s"], 1000.0, nd=2),
+            _fmt(r["pong_age_s"], nd=2),
+            str(r["respawns"]),
+            str(r["lanes_retired"]),
+        ))
+    agg = aggregate_requests(snap)
+    table.append((
+        "fleet", "", "", str(agg), "", "", "", "", "", "",
+    ))
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    for row in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+    if slo and slo.get("slos"):
+        parts = [
+            f"{name}: {_fmt(s.get('worst_burn_rate'), nd=2)}"
+            for name, s in sorted(slo["slos"].items())
+        ]
+        lines.append("burn rates  " + "  ".join(parts))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# live polling
+
+
+def _get_json(url: str, timeout: float = 3.0) -> Optional[Dict[str, Any]]:
+    """GET + parse JSON; non-2xx bodies (a 503 /healthz) still parse."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read().decode("utf-8"))
+        except Exception:
+            return None
+    except (OSError, ValueError):
+        return None
+
+
+def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
+    url = url.rstrip("/")
+    prev: Optional[Dict[str, Any]] = None
+    prev_t: Optional[float] = None
+    while True:
+        snap = _get_json(url + "/snapshot")
+        if snap is None:
+            print(f"fleet_top: no exporter at {url}", file=sys.stderr)
+            return 1
+        health = _get_json(url + "/healthz")
+        slo = _get_json(url + "/slo")
+        now = time.monotonic()
+        dt = (now - prev_t) if prev_t is not None else None
+        if as_json:
+            print(json.dumps({
+                "rows": fleet_rows(snap, health, prev, dt),
+                "aggregate_requests": aggregate_requests(snap),
+                "health": health,
+                "worst_burn_rate": (slo or {}).get("worst_burn_rate"),
+            }, default=str))
+        else:
+            out = render(snap, health, slo, prev, dt)
+            if not once:
+                print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
+            print(out, flush=True)
+        if once:
+            return 0
+        prev, prev_t = snap, now
+        time.sleep(max(0.1, interval))
+
+
+# ---------------------------------------------------------------------------
+# self-check
+
+
+def _synthetic_snapshot() -> Dict[str, Any]:
+    """Two shards plus the merge-produced aggregates, including a shard
+    id that needs label escaping."""
+    buckets0 = {"0.05": 8, "0.25": 1, "+Inf": 1}
+    buckets1 = {"0.05": 3, "0.25": 2, "+Inf": 0}
+    agg = {"0.05": 11, "0.25": 3, "+Inf": 1}
+    return {
+        "counters": {
+            'serve_shard_requests_total{shard="0"}': 10,
+            'serve_shard_requests_total{shard="1"}': 5,
+            "serve_shard_requests_total": 15,
+            'adaptive_lanes_retired_total{entry="serve_dense",shard="0"}': 40,
+            'adaptive_lanes_retired_total{entry="serve_dense",shard="1"}': 20,
+            'shard_respawn_total{shard="1"}': 1,
+            'shard_telemetry_frames_total{shard="we\\"ird\\\\id"}': 3,
+        },
+        "gauges": {
+            'serve_shard_up{shard="0"}': 1.0,
+            'serve_shard_up{shard="1"}': 0.0,
+            'serve_shard_inflight{shard="0"}': 3.0,
+            'serve_shard_last_pong_age_seconds{shard="0"}': 0.4,
+        },
+        "histograms": {
+            'serve_shard_latency_seconds{shard="0"}': {
+                "count": 10, "sum": 0.6, "buckets": buckets0,
+            },
+            'serve_shard_latency_seconds{shard="1"}': {
+                "count": 5, "sum": 0.5, "buckets": buckets1,
+            },
+            "serve_shard_latency_seconds": {
+                "count": 15, "sum": 1.1, "buckets": agg,
+            },
+            'serve_shard_ping_seconds{shard="0"}': {
+                "count": 20, "sum": 0.04,
+                "buckets": {"0.0025": 18, "0.05": 2, "+Inf": 0},
+            },
+        },
+    }
+
+
+def self_check() -> int:
+    failures: List[str] = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        print(f"  {'PASS' if ok else 'FAIL'}  {name}" + (f"  ({detail})" if detail and not ok else ""))
+        if not ok:
+            failures.append(name)
+
+    # round-trip parsing, incl. escaped label values
+    name, labels = parse_series('m{shard="we\\"ird\\\\id",x="a,b"}')
+    check(
+        "parse_series unescapes label values",
+        name == "m" and labels == {"shard": 'we"ird\\id', "x": "a,b"},
+        repr(labels),
+    )
+    check("parse_series bare name", parse_series("up") == ("up", {}))
+    try:
+        parse_series("m{bad")
+        check("parse_series rejects malformed", False)
+    except ValueError:
+        check("parse_series rejects malformed", True)
+
+    snap = _synthetic_snapshot()
+    rows = fleet_rows(
+        snap, health={"shards": {"1": {"up": False, "respawns": 1}}},
+    )
+    by_id = {r["shard"]: r for r in rows}
+    check("one row per shard id", set(by_id) >= {"0", "1"}, str(sorted(by_id)))
+    check(
+        "health overrides liveness",
+        by_id["0"]["up"] and not by_id["1"]["up"],
+    )
+    check(
+        "conservation: aggregate == sum of shards",
+        aggregate_requests(snap)
+        == by_id["0"]["requests"] + by_id["1"]["requests"],
+    )
+    q = hist_quantile(snap["histograms"]['serve_shard_latency_seconds{shard="0"}'], 0.5)
+    check("histogram p50 interpolates", q is not None and 0.0 < q <= 0.05, str(q))
+    q99 = hist_quantile(snap["histograms"]['serve_shard_latency_seconds{shard="0"}'], 0.999)
+    check("+Inf tail clamps to top bound", q99 == 0.25, str(q99))
+    check("empty histogram -> None", hist_quantile({"count": 0, "buckets": {}}, 0.5) is None)
+
+    out = render(
+        snap,
+        health={"ok": False, "queue_depth": 2, "inflight": 3,
+                "shards": {"1": {"up": False, "respawns": 1}}},
+        slo={"worst_burn_rate": 1.25,
+             "slos": {"normal": {"worst_burn_rate": 1.25}}},
+    )
+    check("render shows DOWN shard", "DOWN" in out, out)
+    check("render shows fleet aggregate row", "fleet" in out and "15" in out)
+    check("render shows burn rates", "1.25" in out)
+
+    # qps from a counter delta between two polls
+    prev = json.loads(json.dumps(snap))
+    prev["counters"]['serve_shard_requests_total{shard="0"}'] = 4
+    rows2 = fleet_rows(snap, prev=prev, dt=2.0)
+    r0 = next(r for r in rows2 if r["shard"] == "0")
+    check("qps from counter delta", r0["qps"] == 3.0, str(r0["qps"]))
+
+    print(
+        f"fleet_top self-check: {'OK' if not failures else 'FAILED'} "
+        f"({len(failures)} failure(s))"
+    )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet_top.py",
+        description="live terminal view of a serving fleet's merged telemetry",
+    )
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--url", help="exporter base URL (live mode)")
+    src.add_argument("--snapshot", help="registry snapshot JSON file (one frame)")
+    ap.add_argument("--health", help="optional /healthz JSON file (with --snapshot)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds in live mode (default 2)")
+    ap.add_argument("--once", action="store_true", help="print one frame and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit machine-readable rows instead of the table")
+    ap.add_argument("--self-check", action="store_true",
+                    help="run the built-in synthetic validation")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if args.url:
+        return watch(args.url, args.interval, args.once, args.as_json)
+    if args.snapshot:
+        with open(args.snapshot, "r", encoding="utf-8") as fh:
+            snap = json.load(fh)
+        if isinstance(snap, dict) and "metrics" in snap and "counters" not in snap:
+            snap = snap["metrics"]  # a journal close record works too
+        health = None
+        if args.health:
+            with open(args.health, "r", encoding="utf-8") as fh:
+                health = json.load(fh)
+        if args.as_json:
+            print(json.dumps({
+                "rows": fleet_rows(snap, health),
+                "aggregate_requests": aggregate_requests(snap),
+            }, default=str))
+        else:
+            print(render(snap, health))
+        return 0
+    ap.error("one of --url / --snapshot / --self-check is required")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

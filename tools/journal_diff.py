@@ -60,17 +60,24 @@ DEFAULT_THRESHOLD = 0.10
 _HIGHER_IS_BETTER = (
     "per_sec", "per_chip", "converged", "mfu", "tflops", "utilization",
     "throughput", 'verdict="healthy"', "iters_saved", "cache_hit",
-    "lanes_retired", "goodput", "terminal/complete",
+    "lanes_retired", "goodput", "terminal/complete", "telemetry_frames",
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
 # The fleet counters (shard respawns, requeued lanes, per-tenant quota
-# sheds) only exist once a shard crashed or a tenant hit its rate limit,
-# so a clean baseline has no such series — seeding makes them
-# appearing-from-zero regressions rather than silently uncompared.
+# sheds, telemetry merge errors) only exist once a shard crashed, a
+# tenant hit its rate limit, or a child snapshot failed to fold into the
+# parent registry, so a clean baseline has no such series — seeding
+# makes them appearing-from-zero regressions rather than silently
+# uncompared. shard_telemetry_FRAMES_total is deliberately NOT here:
+# frame counts scale with run length and heartbeat cadence, so a
+# telemetry-on run appearing against a telemetry-off baseline must not
+# trip the gate (and as a higher-is-better volume counter, growth
+# passes while a same-workload drop — a wedged shipper — still flags).
 _ZERO_SEEDED = (
     "solve_verdict_total", "journey/terminal/", "burn_rate",
     "shard_respawn_total", "requeued_lanes_total", "serve_tenant_shed_total",
+    "shard_telemetry_errors_total",
 )
 
 
@@ -612,6 +619,40 @@ def self_check(out=sys.stdout) -> int:
     )
     checks.append(("respawn count tripling fails (lower is better)",
                    True, any(r["regression"] for r in rows)))
+
+    # fleet telemetry plane (serve/shard.py + obs.metrics.merge): merge
+    # errors are zero-seeded (a clean run never fails to fold a child
+    # snapshot); frame counts are higher-is-better volume counters (a
+    # same-workload drop means a wedged shipper, growth is benign); the
+    # shard ping round-trip p95 gates lower-is-better like any latency
+    tbase = {
+        'metric/shard_telemetry_frames_total{shard="0"}': 40.0,
+        'metric/serve_shard_ping_seconds{shard="0"}/p95': 0.002,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def trun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(tbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    trun("identical telemetry metrics pass", dict(tbase), False)
+    trun("telemetry merge errors appearing from zero fail (zero-seeded)",
+         {**tbase, 'metric/shard_telemetry_errors_total{shard="1"}': 1.0},
+         True)
+    trun("shard ping p95 regression >10% fails (lower is better)",
+         {**tbase, 'metric/serve_shard_ping_seconds{shard="0"}/p95': 0.02},
+         True)
+    trun("telemetry frame count growing passes (higher is better)",
+         {**tbase, 'metric/shard_telemetry_frames_total{shard="0"}': 80.0},
+         False)
+    trun("telemetry frame count dropping >10% fails (wedged shipper)",
+         {**tbase, 'metric/shard_telemetry_frames_total{shard="0"}': 10.0},
+         True)
+    rows = compare(
+        {k: v for k, v in tbase.items() if "telemetry" not in k}, tbase,
+    )
+    checks.append(("telemetry-on run vs telemetry-off baseline passes",
+                   False, any(r["regression"] for r in rows)))
 
     ok = True
     for name, want, got in checks:

@@ -17,16 +17,25 @@ continuous-batching win.
 (`dispatches_tpu.serve.make_dense_fleet`: N crash-domain child
 processes); `--kill-shard` SIGKILLs the busiest shard halfway through
 the run to exercise the respawn + requeue path under load.
+`--telemetry` turns on the fleet telemetry plane (children ship their
+metrics registry into the parent, shard-labeled); `--exporter-port P`
+additionally serves /metrics + /healthz + /slo from the generator
+process during the run, so an operator (or `tools/fleet_top.py --url`)
+can watch the fleet live. Fleet reports carry a per-shard
+goodput/latency breakdown next to the fleet totals.
 
 `--self-check` pushes ~200 small LPs through the service, asserts every
 ticket resolves (zero lost requests) and every non-cached solve
 converges, and gates the measured p95 against a generous CPU bound via
 the `journal_diff` comparison machinery (so the gate's direction and
 threshold semantics match the rest of CI). It also runs the fleet chaos
-leg: a 2-shard fleet with one shard killed mid-run must lose zero
-requests, respawn the dead shard, requeue its in-flight lanes, and
-return results bitwise identical to the single-engine service at the
-same bucket. Exit 0 pass / 1 gate trip / 2 error.
+leg: a 2-shard fleet — telemetry plane on, exporter scraped mid-run —
+with one shard killed mid-run must lose zero requests, respawn the dead
+shard, requeue its in-flight lanes, flip /healthz non-200 while down
+(healing after respawn), keep the fleet-aggregate metrics equal to the
+sum of the per-shard series, and return results bitwise identical to
+the single-engine service at the same bucket. Exit 0 pass / 1 gate
+trip / 2 error.
 
 The workload is synthetic: small random feasible box LPs with a
 configurable duplicate fraction (`--dup-frac`) so the fingerprint cache
@@ -125,6 +134,8 @@ def run_service(
     detail: bool = False,
     shards: int = 0,
     kill_shard: bool = False,
+    telemetry: bool = False,
+    exporter_port=None,
 ) -> dict:
     """Drive the service at `rate` req/s; returns the report dict.
     `reqtrace` records per-request journeys into the process tracer's
@@ -132,7 +143,10 @@ def run_service(
     (for validation — omitted from normal reports to keep them small).
     `shards > 0` serves through the sharded fleet instead of the
     in-process engine; `kill_shard` SIGKILLs the busiest shard halfway
-    through the submissions (chaos: respawn + requeue under load)."""
+    through the submissions (chaos: respawn + requeue under load).
+    `telemetry` (fleet only) ships shard-child registry deltas into the
+    parent registry; `exporter_port` serves /metrics + /healthz + /slo
+    from this process for the duration of the run (0 = ephemeral)."""
     _enable_x64()
     from dispatches_tpu.serve import make_dense_fleet, make_dense_service
 
@@ -140,6 +154,7 @@ def run_service(
         svc = make_dense_fleet(
             shards, bucket, chunk_iters=chunk_iters,
             queue_limit=queue_limit, reqtrace=reqtrace,
+            telemetry=telemetry,
             solver_kw={"max_iter": max_iter},
         )
     else:
@@ -157,6 +172,15 @@ def run_service(
     sched = arrival_schedule(requests, rate, seed)
 
     svc.start()
+    exporter = None
+    if exporter_port is not None:
+        from dispatches_tpu.obs.exporter import TelemetryExporter
+
+        exporter = TelemetryExporter(
+            int(exporter_port),
+            health_fn=svc.health if shards > 0 else None,
+        ).start()
+        print(f"exporter: {exporter.url('/metrics')}", file=sys.stderr)
     t0 = time.monotonic()
     tickets = []
     killed = None
@@ -179,6 +203,8 @@ def run_service(
                     killed = busy[0]
         results = [t.result(timeout=240.0) for t in tickets]
     finally:
+        if exporter is not None:
+            exporter.stop()
         if shards > 0:
             svc.close()
         else:
@@ -213,6 +239,21 @@ def run_service(
         report["mode"] = "fleet"
         report["shards"] = shards
         report["killed_shard"] = killed
+        # per-shard goodput/latency breakdown: the crash-domain view of
+        # the same run (feeds the bench.py serve row). Each shard's
+        # goodput uses the shared wall clock — shards serve concurrently,
+        # so the per-shard rates sum to the fleet goodput.
+        report["per_shard"] = {
+            k: {
+                **v,
+                "goodput_rps": (
+                    v.get("completed", 0) / wall if wall > 0 else 0.0
+                ),
+            }
+            for k, v in (report["service"].get("per_shard") or {}).items()
+        }
+    if exporter is not None:
+        report["exporter_url"] = exporter.url()
     if detail:
         report["latencies_by_id"] = {
             r.request_id: r.latency for r in results
@@ -314,6 +355,135 @@ def _terminal_mini_pass(out) -> dict:
     }
 
 
+def _http_get(url: str):
+    """(status, body) even for non-2xx responses — /healthz 503 is a
+    *signal* here, not an error."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _shard_counter_deltas(before: dict, after: dict):
+    """Counter deltas between two registry snapshots, split into
+    shard-labeled and label-free series, restricted to the child-only
+    engine counters (``adaptive_*`` / ``compile_cache_*`` — names the
+    fleet parent never increments itself, so their unlabeled aggregates
+    come exclusively from `MetricsRegistry.merge`). Returns
+    ``(labeled, unlabeled)`` keyed by ``(name, base-label-items)`` with
+    ``labeled`` values mapping shard id -> delta."""
+    from dispatches_tpu.obs.metrics import parse_series
+
+    labeled, unlabeled = {}, {}
+    for series in set(before) | set(after):
+        d = after.get(series, 0.0) - before.get(series, 0.0)
+        if d == 0:
+            continue
+        name, labels = parse_series(series)
+        if not name.startswith(("adaptive_", "compile_cache_")):
+            continue
+        shard = labels.pop("shard", None)
+        key = (name, tuple(sorted(labels.items())))
+        if shard is None:
+            unlabeled[key] = unlabeled.get(key, 0.0) + d
+        else:
+            labeled.setdefault(key, {})[shard] = d
+    return labeled, unlabeled
+
+
+def _telemetry_checks(fleet, exporter, before, n_solved, out) -> list:
+    """The telemetry-plane acceptance checks, run after the chaos drain:
+    both children (including the respawned one) shipped shard-labeled
+    series, the label-free fleet aggregates equal the sum of the
+    per-shard series (conservation — on counter DELTAS against the
+    pre-fleet snapshot, because earlier self-check legs already
+    populated the unlabeled names in this process), the scrape endpoint
+    carries both shards, and the parent-side per-shard request counters
+    sum to the fleet's ok count."""
+    from dispatches_tpu.obs import metrics as obs_metrics
+
+    failures = []
+    # children ship deltas on the heartbeat: pump until the post-drain
+    # ping carried the final chunk counters from both shards
+    labeled, unlabeled = {}, {}
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        fleet.pump()
+        after = obs_metrics.snapshot()["counters"]
+        labeled, unlabeled = _shard_counter_deltas(before, after)
+        shards_seen = {s for m in labeled.values() for s in m}
+        if {"0", "1"} <= shards_seen and set(labeled) == set(unlabeled):
+            break
+        time.sleep(0.02)
+    shards_seen = {s for m in labeled.values() for s in m}
+    if not {"0", "1"} <= shards_seen:
+        failures.append(
+            f"telemetry: expected engine counters from both shards, "
+            f"saw shards {sorted(shards_seen)}"
+        )
+    bad = [
+        (name, dict(base), sum(m.values()), unlabeled.get((name, base)))
+        for (name, base), m in labeled.items()
+        if abs(sum(m.values()) - unlabeled.get((name, base), 0.0)) > 1e-6
+    ]
+    if bad:
+        failures.append(
+            f"telemetry: fleet aggregate != sum of per-shard series "
+            f"(first: {bad[0]})"
+        )
+    else:
+        print(
+            f"telemetry: conservation holds over {len(labeled)} merged "
+            f"counter series from shards {sorted(shards_seen)}", file=out,
+        )
+    # parent-side shard attribution closes the loop the other way:
+    # per-shard request counts sum to the fleet's solved count
+    after = obs_metrics.snapshot()["counters"]
+    by_shard = sum(
+        after.get(s, 0.0) - before.get(s, 0.0)
+        for s in after
+        if s.startswith("serve_shard_requests_total{")
+    )
+    if int(by_shard) != n_solved:
+        failures.append(
+            f"telemetry: serve_shard_requests_total sums to {by_shard}, "
+            f"expected {n_solved} solved requests"
+        )
+    st = fleet.stats()
+    ps_total = sum(
+        int(v.get("completed", 0)) for v in st.get("per_shard", {}).values()
+    )
+    if ps_total != n_solved:
+        failures.append(
+            f"telemetry: stats per_shard completed sums to {ps_total}, "
+            f"expected {n_solved}"
+        )
+    # the scrape surface: /metrics must expose both shards' series
+    code, body = _http_get(exporter.url("/metrics"))
+    if code != 200:
+        failures.append(f"telemetry: /metrics returned {code}")
+    else:
+        for want in ('shard="0"', 'shard="1"', "serve_shard_ping_seconds"):
+            if want not in body:
+                failures.append(f"telemetry: /metrics missing {want!r}")
+    code, body = _http_get(exporter.url("/slo"))
+    if code != 200 or "worst_burn_rate" not in json.loads(body):
+        failures.append(f"telemetry: /slo unusable (status {code})")
+    if int(st.get("telemetry_frames", 0)) < 2:
+        failures.append(
+            f"telemetry: only {st.get('telemetry_frames')} frames merged"
+        )
+    if int(st.get("telemetry_errors", 0)):
+        failures.append(
+            f"telemetry: {st['telemetry_errors']} merge errors"
+        )
+    return failures
+
+
 def _fleet_chaos_pass(out) -> list:
     """The fleet's acceptance scenario: a 2-shard fleet with one shard
     SIGKILLed while it holds in-flight lanes must (a) lose zero tickets,
@@ -321,9 +491,20 @@ def _fleet_chaos_pass(out) -> list:
     lanes, and (d) return every result bitwise identical to the
     single-engine service at the same bucket (requeued lanes re-solve
     from iteration 0, so the crash leaves no numeric trace). Also covers
-    the ``shed_tenant_quota`` verdict via a rate-limited tenant."""
+    the ``shed_tenant_quota`` verdict via a rate-limited tenant.
+
+    This leg also runs with the full telemetry plane on — children ship
+    registry deltas and journey marks, the parent serves an exporter —
+    and asserts the plane's own contracts: /healthz flips non-200 while
+    the shard is down and heals after respawn, both children's series
+    reach /metrics, and the fleet aggregates equal the sum of the
+    per-shard series (see `_telemetry_checks`). The bitwise comparison
+    in (d) therefore also witnesses telemetry-neutrality: results with
+    the whole plane enabled match a plain single-engine service."""
     import numpy as np
 
+    from dispatches_tpu.obs import metrics as obs_metrics
+    from dispatches_tpu.obs.exporter import TelemetryExporter
     from dispatches_tpu.serve import (
         TenantConfig,
         make_dense_fleet,
@@ -334,11 +515,14 @@ def _fleet_chaos_pass(out) -> list:
     bucket = 4
     seeds = list(range(8000, 8024))
     problems = {s: make_problem(s) for s in seeds}
+    before = obs_metrics.snapshot()["counters"]
     fleet = make_dense_fleet(
         2, bucket, chunk_iters=4, cache_size=None,
         tenants={"limited": TenantConfig(rate=0.001, burst=1.0)},
         solver_kw={"max_iter": 60},
+        reqtrace=True, telemetry=True, heartbeat_every=0.1,
     )
+    exporter = TelemetryExporter(0, health_fn=fleet.health).start()
     lost = 0
     results = {}
     try:
@@ -379,7 +563,42 @@ def _fleet_chaos_pass(out) -> list:
                 f"fleet chaos: killed shard {victim} with "
                 f"{n_inflight} lanes in flight", file=out,
             )
+            # the prober's view of the crash: /healthz must flip non-200
+            # while the shard is down / backing off...
+            code = None
+            t0 = time.monotonic()
+            while code != 503 and time.monotonic() - t0 < 30.0:
+                fleet.pump()
+                code, body = _http_get(exporter.url("/healthz"))
+            if code != 503:
+                failures.append(
+                    f"fleet chaos: /healthz never flipped non-200 after "
+                    f"kill (last status {code})"
+                )
+            elif not json.loads(body).get("shards"):
+                failures.append(
+                    "fleet chaos: /healthz 503 body lacks per-shard detail"
+                )
+            else:
+                print("fleet chaos: /healthz 503 while shard down", file=out)
         fleet.drain(timeout=300.0)
+        if victim is not None:
+            # ...and heal back to 200 once the respawn landed (drain
+            # already waited for the re-solves, so only the ping/pong
+            # liveness view can lag here)
+            code = None
+            t0 = time.monotonic()
+            while code != 200 and time.monotonic() - t0 < 30.0:
+                fleet.pump()
+                code, _ = _http_get(exporter.url("/healthz"))
+                if code != 200:
+                    time.sleep(0.05)
+            if code != 200:
+                failures.append(
+                    "fleet chaos: /healthz never recovered after respawn"
+                )
+            else:
+                print("fleet chaos: /healthz healed after respawn", file=out)
         st = fleet.stats()
         for s, t in tickets.items():
             if t.done():
@@ -405,7 +624,12 @@ def _fleet_chaos_pass(out) -> list:
             f"respawns={st['respawns']} requeued={st['requeued_lanes']} "
             f"tenant_shed={st['tenant_shed']}", file=out,
         )
+        n_solved = sum(
+            1 for r in results.values() if r.verdict in ("healthy", "slow")
+        ) + (t_ok.done() and t_ok.result(0).verdict in ("healthy", "slow"))
+        failures += _telemetry_checks(fleet, exporter, before, n_solved, out)
     finally:
+        exporter.stop()
         fleet.close()
 
     if lost or not results:
@@ -615,6 +839,13 @@ def main(argv=None) -> int:
     ap.add_argument("--kill-shard", action="store_true",
                     help="chaos: SIGKILL the busiest shard halfway through "
                     "the run (requires --shards >= 2)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="fleet only: children ship metrics-registry and "
+                    "journal deltas into the parent on the heartbeat")
+    ap.add_argument("--exporter-port", type=int, default=None,
+                    help="serve /metrics /healthz /slo /snapshot on this "
+                    "port for the duration of the run (0 = ephemeral; "
+                    "implies --telemetry when --shards > 0)")
     ap.add_argument("--baseline", choices=["serial"], default=None,
                     help="run the one-at-a-time baseline instead")
     ap.add_argument("--json", action="store_true",
@@ -658,6 +889,10 @@ def main(argv=None) -> int:
                 queue_limit=args.queue_limit, dup_frac=args.dup_frac,
                 seed=args.seed, deadline_s=args.deadline, reqtrace=reqtrace,
                 shards=args.shards, kill_shard=args.kill_shard,
+                telemetry=args.telemetry or (
+                    args.shards > 0 and args.exporter_port is not None
+                ),
+                exporter_port=args.exporter_port,
             )
         finally:
             if tracer is not None:

@@ -38,6 +38,13 @@ fleet (`make_dense_fleet`: N crash-domain child processes with respawn
 and per-tenant fairness — requests may carry a ``tenant`` id) instead of
 the in-process engine. Unknown ops and malformed lines produce an
 ``{"error": ...}`` response instead of killing the loop.
+
+``--exporter-port P`` serves the fleet telemetry plane over HTTP for
+the lifetime of the loop: ``/metrics`` (Prometheus), ``/healthz``
+(per-shard liveness, non-200 while any shard is down), ``/slo`` (burn
+rates) and ``/snapshot`` (see docs/serving.md). In ``--shards`` mode it
+implies ``--telemetry``, so the scrape carries ``shard``-labeled series
+merged from every child next to the fleet aggregates.
 """
 from __future__ import annotations
 
@@ -150,6 +157,13 @@ def main(argv=None, out=sys.stdout) -> int:
                     help="write a JSONL run journal here")
     ap.add_argument("--reqtrace", action="store_true",
                     help="record per-request journeys (journal schema v3)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="--shards mode: children ship metrics-registry "
+                    "and journal deltas into the parent on the heartbeat")
+    ap.add_argument("--exporter-port", type=int, default=None,
+                    help="serve /metrics /healthz /slo /snapshot on this "
+                    "port (0 = ephemeral, printed to stderr; implies "
+                    "--telemetry when --shards > 0)")
     args = ap.parse_args(argv)
 
     import jax
@@ -165,6 +179,24 @@ def main(argv=None, out=sys.stdout) -> int:
         set_tracer(tracer)
 
     svc = None
+    exporter = None
+    if args.exporter_port is not None:
+        from dispatches_tpu.obs.exporter import TelemetryExporter
+
+        def _health():
+            # closure over `svc`: the service is built lazily at the
+            # first solve, so the prober sees "idle but ok" until then
+            if svc is None:
+                return {"ok": True, "idle": True}
+            if args.shards > 0:
+                return svc.health()
+            return {"ok": True}
+
+        exporter = TelemetryExporter(
+            args.exporter_port, health_fn=_health
+        ).start()
+        print(f"exporter: {exporter.url('/metrics')}", file=sys.stderr)
+
     reaper = _Reaper(out)
     fh = sys.stdin if args.input == "-" else open(args.input, "r")
     try:
@@ -185,6 +217,9 @@ def main(argv=None, out=sys.stdout) -> int:
                                 queue_limit=args.queue_limit,
                                 cache_size=args.cache_size or None,
                                 reqtrace=args.reqtrace,
+                                telemetry=args.telemetry or (
+                                    args.exporter_port is not None
+                                ),
                                 solver_kw={"max_iter": args.max_iter},
                             )
                         else:
@@ -223,6 +258,8 @@ def main(argv=None, out=sys.stdout) -> int:
     finally:
         if fh is not sys.stdin:
             fh.close()
+        if exporter is not None:
+            exporter.stop()
         if svc is not None:
             svc.stop(drain=True)
             if args.shards > 0:

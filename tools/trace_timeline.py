@@ -7,9 +7,11 @@ Trace Event Format that chrome://tracing and Perfetto load directly —
 one track (tid) per slot lane showing chunk segments, plus a queue
 track showing each request's admission-queue residency and the
 shed / deadline / cache-hit instants. Fleet journals (chunks carrying a
-``shard`` field) get one track per (shard, slot) pair — named
-``shard K · slot S`` — so a respawn-and-requeue shows up as the same
-request hopping tracks.
+``shard`` field) give each shard its own *process* track (pid) — the
+crash domain IS a process, so Perfetto groups its slot lanes under a
+``shard K`` header, and a respawn-and-requeue shows up as the same
+request hopping process tracks. The parent service (queue + any
+single-engine lanes) stays on pid 1.
 
 Usage:
     python tools/trace_timeline.py JOURNAL.jsonl -o timeline.trace.json
@@ -29,15 +31,23 @@ from typing import Any, Dict, List, Optional
 RC_OK, RC_ERROR = 0, 2
 
 QUEUE_TID = 0  # lane tracks get sequential tids starting at 1
+SERVICE_PID = 1  # parent process: queue track + single-engine lanes
 _US = 1e6  # journey stamps are seconds; trace events want microseconds
 
 
 def _lane_key(chunk: dict):
     """Track identity of a chunk: (shard, slot). Single-engine journals
-    have no shard field; -1 sorts their tracks ahead of any fleet shard
-    (and keeps slot 0 on tid 1, as before the fleet existed)."""
+    have no shard field; -1 keeps their lanes on the parent service pid
+    (and slot 0 on tid 1, as before the fleet existed)."""
     shard = chunk.get("shard")
     return (shard if isinstance(shard, int) else -1, chunk["slot"])
+
+
+def _pid_of(shard: int) -> int:
+    """Shard k is its own trace *process* (crash domain == process), so
+    Perfetto groups its slot lanes under one `shard k` header. Shard -1
+    (single-engine) shares the parent service pid."""
+    return SERVICE_PID if shard < 0 else SERVICE_PID + 1 + shard
 
 
 def read_jsonl(path: str) -> List[dict]:
@@ -85,10 +95,9 @@ def export_trace(records: List[dict]) -> Dict[str, Any]:
     """Build the Chrome trace-event object for the journeys in
     `records`. Times are shifted so the earliest submit is t=0."""
     js = journeys_of(records)
-    pid = 1
     events: List[dict] = [
-        _meta(pid, 0, "dispatch-service", "process_name"),
-        _meta(pid, QUEUE_TID, "queue", "thread_name"),
+        _meta(SERVICE_PID, 0, "dispatch-service", "process_name"),
+        _meta(SERVICE_PID, QUEUE_TID, "queue", "thread_name"),
     ]
     if not js:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
@@ -97,10 +106,19 @@ def export_trace(records: List[dict]) -> Dict[str, Any]:
         _lane_key(c) for j in js for c in j.get("chunks", [])
         if isinstance(c.get("slot"), int)
     })
-    lane_tid = {key: 1 + i for i, key in enumerate(lanes)}
-    for (shard, slot), tid in sorted(lane_tid.items(), key=lambda kv: kv[1]):
-        name = f"slot {slot}" if shard < 0 else f"shard {shard} · slot {slot}"
-        events.append(_meta(pid, tid, name, "thread_name"))
+    # tids restart at 1 inside each pid so every process shows a compact
+    # stack of slot lanes rather than one global tid namespace
+    lane_track: Dict[Any, tuple] = {}
+    next_tid: Dict[int, int] = {}
+    for key in lanes:
+        shard, slot = key
+        lpid = _pid_of(shard)
+        tid = next_tid.get(lpid, 1)
+        next_tid[lpid] = tid + 1
+        lane_track[key] = (lpid, tid)
+        if tid == 1 and shard >= 0:
+            events.append(_meta(lpid, 0, f"shard {shard}", "process_name"))
+        events.append(_meta(lpid, tid, f"slot {slot}", "thread_name"))
 
     for j in js:
         t0 = float(j["t0"])
@@ -120,18 +138,20 @@ def export_trace(records: List[dict]) -> Dict[str, Any]:
         if isinstance(qw, (int, float)) and qw >= 0:
             qstart = t0 + float(phases.get("admit_s") or 0.0)
             events.append({
-                "ph": "X", "pid": pid, "tid": QUEUE_TID, "cat": "queue",
+                "ph": "X", "pid": SERVICE_PID, "tid": QUEUE_TID,
+                "cat": "queue",
                 "name": name, "ts": (qstart - origin) * _US,
                 "dur": float(qw) * _US, "args": args,
             })
-        # chunk segments on the lane tracks
+        # chunk segments on the lane tracks (per-shard pids in fleet mode)
         last_key = None
         for c in j.get("chunks", []):
             if not isinstance(c.get("slot"), int):
                 continue
             last_key = _lane_key(c)
+            cpid, ctid = lane_track[last_key]
             events.append({
-                "ph": "X", "pid": pid, "tid": lane_tid[last_key],
+                "ph": "X", "pid": cpid, "tid": ctid,
                 "cat": "chunk", "name": name,
                 "ts": (t0 + float(c.get("t", 0.0)) - origin) * _US,
                 "dur": max(float(c.get("dur", 0.0)), 0.0) * _US,
@@ -147,8 +167,9 @@ def export_trace(records: List[dict]) -> Dict[str, Any]:
                 float(phases.get(k) or 0.0)
                 for k in ("admit_s", "queue_wait_s", "slot_admit_s", "compute_s")
             )
+            hpid, htid = lane_track[last_key]
             events.append({
-                "ph": "X", "pid": pid, "tid": lane_tid[last_key],
+                "ph": "X", "pid": hpid, "tid": htid,
                 "cat": "harvest",
                 "name": f"{name} harvest", "ts": (t0 + off - origin) * _US,
                 "dur": float(hv) * _US, "args": args,
@@ -156,7 +177,7 @@ def export_trace(records: List[dict]) -> Dict[str, Any]:
         # terminal instant on the queue track for non-solved endings
         if j.get("terminal") in ("shed", "deadline_exceeded", "cache_hit"):
             events.append({
-                "ph": "i", "pid": pid, "tid": QUEUE_TID, "s": "t",
+                "ph": "i", "pid": SERVICE_PID, "tid": QUEUE_TID, "s": "t",
                 "cat": "terminal", "name": f"{name} {j['terminal']}",
                 "ts": (t0 + float(j["latency_s"]) - origin) * _US,
                 "args": args,
@@ -263,20 +284,24 @@ def self_check() -> int:
         ("has complete spans", "X" in kinds),
         ("has terminal instants", "i" in kinds),
         ("chunk events on lane track", any(
-            e.get("cat") == "chunk" and e.get("tid") == 1 for e in evs
+            e.get("cat") == "chunk" and e.get("pid") == SERVICE_PID
+            and e.get("tid") == 1 for e in evs
         )),
         ("queue spans on queue track", any(
-            e.get("cat") == "queue" and e.get("tid") == QUEUE_TID for e in evs
+            e.get("cat") == "queue" and e.get("pid") == SERVICE_PID
+            and e.get("tid") == QUEUE_TID for e in evs
         )),
-        ("per-shard lane tracks named", sum(
-            1 for e in evs
-            if e.get("ph") == "M" and e.get("name") == "thread_name"
-            and str(e.get("args", {}).get("name", "")).startswith("shard ")
-        ) == 2),
-        ("requeued request spans two shard tracks", len({
-            e["tid"] for e in evs
+        ("each shard is its own named process", sorted(
+            str(e.get("args", {}).get("name"))
+            for e in evs
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and e.get("pid") != SERVICE_PID
+        ) == ["shard 0", "shard 1"]),
+        ("requeued request spans two shard pids", len({
+            e["pid"] for e in evs
             if e.get("cat") == "chunk"
             and e.get("args", {}).get("request_id") == "r4"
+            and e.get("pid") != SERVICE_PID
         }) == 2),
         ("round-trips through JSON", json.loads(json.dumps(trace)) == trace),
         ("empty journal degrades", validate_trace(
