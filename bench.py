@@ -955,7 +955,7 @@ def main():
     ws_saved = int(it_cold.sum() - it_warm.sum())
     if ws_saved > 0:
         obs_metrics.inc("warm_start_iters_saved_total", ws_saved,
-                        runner="bench_weekly")
+                        runner="bench_weekly", source="neighbor")
     _LOCAL["rows"]["weekly_warmstart"] = {
         "lanes": int(it_cold.shape[0]),
         "iters_cold": [int(v) for v in it_cold],
@@ -1014,7 +1014,7 @@ def main():
     bt_warm = sum(r[2] for r in bt)
     if bt_cold > bt_warm:
         obs_metrics.inc("warm_start_iters_saved_total", bt_cold - bt_warm,
-                        runner="bench_battsweep")
+                        runner="bench_battsweep", source="neighbor")
     _LOCAL["rows"]["battsweep_warmstart"] = {
         "points": [
             {"ratio": r[0], "iters_cold": r[1], "iters_warm": r[2],
@@ -1340,6 +1340,68 @@ def main():
     _flush_local()
     _journal().event(
         "row", row="serve_loadgen", **_LOCAL["rows"]["serve_loadgen"]
+    )
+
+    # Learned warm-start serving row (dispatches_tpu/learn): train a
+    # per-family predictor on a cold solve sweep of the loadgen family,
+    # replay a fresh request stream through the safeguarded warm path,
+    # and record the safeguard accept rate + iterations saved. Rides the
+    # serve block because it shares loadgen's x64 convention.
+    def _serve_warmstart_row():
+        import shutil
+        import tempfile
+
+        from dispatches_tpu.learn import (
+            DatasetWriter, load_dataset, train_warmstart_model,
+        )
+        from dispatches_tpu.solvers.ipm import solve_lp as _slp
+
+        tmp = tempfile.mkdtemp(prefix="bench-warm-")
+        try:
+            writer = DatasetWriter(
+                os.path.join(tmp, "dataset"), varying=("A", "b", "c"),
+            )
+            for s in range(9000, 9000 + (48 if smoke else 96)):
+                p = _loadgen.make_problem(s)
+                sol = _slp(p)
+                writer.add(p, sol, iterations=int(np.asarray(sol.iterations)))
+            writer.close()
+            model, mtr = train_warmstart_model(
+                load_dataset([os.path.join(tmp, "dataset")],
+                             varying=("A", "b", "c")),
+                hidden=(48, 48), epochs=200 if smoke else 400, seed=0,
+            )
+            path = model.save(os.path.join(tmp, "warm"))
+            rep = _loadgen.run_service(
+                requests=24 if smoke else 48, rate=sv_rate,
+                bucket=4 if smoke else 8, dup_frac=0.0, seed=9500,
+                warm_model=path,
+            )
+            return rep, mtr
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    wv, wv_mtr = _device("serve learned warm-start", _serve_warmstart_row)
+    wv_warm = wv.get("warm") or {}
+    _LOCAL["rows"]["serve_warmstart"] = {
+        "requests": wv["requests"],
+        "accepted": wv_warm.get("accepted", 0.0),
+        "rejected": wv_warm.get("rejected", 0.0),
+        "iters_saved": wv_warm.get("iters_saved", 0.0),
+        "lost": wv["lost"],
+        "unhealthy": wv["unhealthy"],
+        "holdout_rel_err": wv_mtr.get("holdout_rel_err"),
+        "cold_iters_mean": wv_mtr.get("cold_iters_mean"),
+        "gate_ok": (
+            wv["lost"] == 0 and wv["unhealthy"] == 0
+            and wv_warm.get("iters_saved", 0.0) > 0.0
+        ),
+    }
+    _DIAG["serve"]["warmstart"] = dict(_LOCAL["rows"]["serve_warmstart"])
+    _atomic_dump(_DIAG, _DIAG_PATH)
+    _flush_local()
+    _journal().event(
+        "row", row="serve_warmstart", **_LOCAL["rows"]["serve_warmstart"]
     )
 
     result = {

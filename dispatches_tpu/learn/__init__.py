@@ -1,0 +1,43 @@
+"""Learned warm-start subsystem: train primal-dual predictors from
+journaled solves, serve them through the safeguarded warm-start path.
+
+- `learn.dataset` — supervised (parameters -> converged iterate) pairs
+  from journals, recorder captures, and `DatasetWriter` shard archives,
+  keyed by structural `family_fingerprint`.
+- `learn.warmstart` — per-family MLP training (reusing the surrogate
+  loop) and the versioned, refuse-to-load-on-mismatch ``.npz`` artifact.
+- `learn.predictor` — batch-safe online inference feeding the solvers'
+  safeguarded ``warm_start=`` plumbing; bad predictions degrade to the
+  cold path, never to wrong answers.
+
+See docs/learned_warmstarts.md; the CLI is tools/train_warmstart.py.
+"""
+from .dataset import (
+    DatasetWriter,
+    WarmStartDataset,
+    family_fingerprint,
+    features_of,
+    load_dataset,
+    targets_of,
+)
+from .warmstart import (
+    ARTIFACT_VERSION,
+    ArtifactMismatch,
+    WarmStartModel,
+    train_warmstart_model,
+)
+from .predictor import WarmStartPredictor
+
+__all__ = [
+    "ARTIFACT_VERSION",
+    "ArtifactMismatch",
+    "DatasetWriter",
+    "WarmStartDataset",
+    "WarmStartModel",
+    "WarmStartPredictor",
+    "family_fingerprint",
+    "features_of",
+    "load_dataset",
+    "targets_of",
+    "train_warmstart_model",
+]

@@ -1,0 +1,231 @@
+"""Online warm-start inference for the adaptive and serving paths.
+
+`WarmStartPredictor` adapts a trained `learn.warmstart.WarmStartModel` to
+the ``warm_start=`` plumbing of `runtime.adaptive` and the SlotEngine
+cold dispatch of `serve/`: given a batch of single-lane problems it
+returns per-lane solution-frame seeds plus the accept verdict the
+solver's own safeguard will reach.
+
+Safety contract (the load-bearing part):
+
+- A prediction NEVER gates correctness. Seeds always flow through the
+  PR-4 clip + per-lane wholesale-rejection safeguard inside the solvers
+  (`solvers.ipm._warm_safeguard`, the PDHG projection/finite fallback);
+  the predictor merely *also* evaluates `solvers.ipm.warm_start_accept`
+  host-side so accept/reject is observable
+  (``learned_warm_accept_total`` / ``learned_warm_reject_total``).
+- Degradation is always toward the cold path. Family mismatch, feature
+  dimension drift, a wrong problem type, non-finite model output, or any
+  internal error produce NaN seeds — which the solver rejects wholesale
+  per lane, landing bitwise on the cold start (asserted in
+  tests/test_learn.py).
+- ``seed_rows`` never raises: serving must not crash on a bad artifact.
+
+The iters-saved attribution baseline (``cold_iters_mean``) rides in the
+artifact manifest; `SlotEngine` uses it to credit
+``warm_start_iters_saved_total{source="learned"}`` at harvest.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from .dataset import family_fingerprint, features_of
+from .warmstart import WarmStartModel
+
+obs_metrics.describe(
+    "learned_warm_accept_total",
+    "learned warm-start seeds the solver safeguard accepted",
+)
+obs_metrics.describe(
+    "learned_warm_reject_total",
+    "learned warm-start seeds rejected to the cold path (per lane)",
+)
+
+# iterate parts a seed must supply per problem type (= the solver's
+# warm_start tuple layout)
+_PARTS_BY_TYPE = {
+    "LPData": ("x", "y", "zl", "zu"),
+    "BandedLP": ("x", "y", "zl", "zu"),
+    "SparseLP": ("x", "y"),
+}
+
+
+class WarmStartPredictor:
+    """Batch-safe online inference over one warm-start artifact.
+
+    `model` is a `WarmStartModel` or a path to a saved artifact (loaded
+    with `expect_family` forwarded, so a wrong artifact refuses at
+    construction, not at request time). `source` labels the obs counters
+    and journal fields; `check_family` hashes each row's structural
+    fingerprint against the manifest (exact, but it rehashes the
+    non-varying fields per row — disable only when the caller guarantees
+    the family by construction)."""
+
+    def __init__(
+        self,
+        model: Any,
+        *,
+        expect_family: Optional[str] = None,
+        source: str = "learned",
+        check_family: bool = True,
+    ):
+        if isinstance(model, (str, bytes)):
+            model = WarmStartModel.load(str(model), expect_family=expect_family)
+        elif expect_family is not None and model.family != expect_family:
+            from .warmstart import ArtifactMismatch
+
+            raise ArtifactMismatch(
+                f"predictor family {model.family!r:.24}... != expected "
+                f"{expect_family!r:.24}..."
+            )
+        self.model = model
+        self.source = str(source)
+        self.check_family = bool(check_family)
+        self._accept_fn = None
+        self._parts = dict(self.model.targets)
+
+    @property
+    def cold_iters_mean(self) -> Optional[float]:
+        return self.model.cold_iters_mean
+
+    # -- internals -----------------------------------------------------
+    def _nan_seed(self, row) -> Tuple[np.ndarray, ...]:
+        """A seed the solver safeguard is guaranteed to reject, shaped
+        from the ROW (never the manifest — a family-mismatched artifact
+        must not leak its shapes into the solver)."""
+        dtype = np.asarray(row.b).dtype
+        n = int(np.asarray(row.c).shape[-1])
+        m = int(np.asarray(row.b).shape[-1])
+        nan = lambda k: np.full((k,), np.nan, dtype)  # noqa: E731
+        if type(row).__name__ == "SparseLP":
+            return (nan(n), nan(m))
+        return (nan(n), nan(m), nan(n), nan(n))
+
+    def _accept_ipm(self, rows, seeds) -> List[bool]:
+        """Exact per-lane safeguard verdict via the solver's own
+        `warm_start_accept`, vmapped over the stacked batch."""
+        import jax
+
+        from ..solvers.ipm import warm_start_accept
+
+        if self._accept_fn is None:
+            self._accept_fn = jax.jit(jax.vmap(warm_start_accept))
+        cls = type(rows[0])
+        lp = cls(*(
+            np.stack([np.asarray(f) for f in col])
+            for col in zip(*rows)
+        ))
+        warm = tuple(
+            np.stack([s[j] for s in seeds]) for j in range(len(seeds[0]))
+        )
+        return [bool(v) for v in np.asarray(self._accept_fn(lp, warm))]
+
+    # -- public API ----------------------------------------------------
+    def seed_rows(
+        self, rows: Sequence[Any], entry: Optional[str] = None
+    ) -> Tuple[Optional[List[Tuple[np.ndarray, ...]]], Optional[List[bool]]]:
+        """Per-lane seeds for a batch of single-lane problems. Returns
+        ``(seeds, accepted)`` — ``seeds[i]`` is the solver warm_start
+        tuple for lane i (NaN tuple when the lane is unservable, which
+        the solver rejects to the cold path), ``accepted[i]`` the
+        safeguard verdict. Returns ``(None, None)`` only when even a NaN
+        fallback cannot be built (unknown problem layout) — callers then
+        run plainly cold. Increments the accept/reject counters; never
+        raises."""
+        try:
+            rows = list(rows)
+            if not rows:
+                return [], []
+            parts_needed = _PARTS_BY_TYPE.get(type(rows[0]).__name__)
+            if parts_needed is None:
+                return None, None
+            seeds: List[Optional[Tuple[np.ndarray, ...]]] = [None] * len(rows)
+            good: List[int] = []
+            feats: List[np.ndarray] = []
+            mdl = self.model
+            usable = (
+                type(rows[0]).__name__ == mdl.problem_type
+                and all(p in self._parts for p in parts_needed)
+            )
+            for i, row in enumerate(rows):
+                if not usable:
+                    continue
+                try:
+                    x = features_of(row, mdl.varying)
+                    if x.size != mdl.feature_dim or not np.all(np.isfinite(x)):
+                        continue
+                    if self.check_family and (
+                        family_fingerprint(row, mdl.varying) != mdl.family
+                    ):
+                        continue
+                except Exception:
+                    continue
+                good.append(i)
+                feats.append(x)
+            if good:
+                parts = mdl.predict_parts(np.stack(feats))
+                for j, i in enumerate(good):
+                    dtype = np.asarray(rows[i].b).dtype
+                    seed = tuple(
+                        np.asarray(parts[p][j], dtype) for p in parts_needed
+                    )
+                    fallback = self._nan_seed(rows[i])
+                    if tuple(a.shape for a in seed) != tuple(
+                        a.shape for a in fallback
+                    ):
+                        # wrong-shape artifact: a seed the engine cannot
+                        # even buffer — reject it here, not in a crash
+                        seed = fallback
+                    seeds[i] = seed
+            for i, s in enumerate(seeds):
+                if s is None:
+                    seeds[i] = self._nan_seed(rows[i])
+            # accept verdicts: exact (solver-identical) for IPM seeds,
+            # finite-check for the rest (PDHG projects any finite seed)
+            try:
+                if parts_needed == ("x", "y", "zl", "zu") and (
+                    type(rows[0]).__name__ == "LPData"
+                ):
+                    accepted = self._accept_ipm(rows, seeds)
+                else:
+                    accepted = [
+                        all(bool(np.all(np.isfinite(a))) for a in s)
+                        for s in seeds
+                    ]
+            except Exception:
+                accepted = [False] * len(rows)
+            labels = {"source": self.source}
+            if entry:
+                labels["entry"] = entry
+            n_acc = sum(accepted)
+            if n_acc:
+                obs_metrics.inc("learned_warm_accept_total", n_acc, **labels)
+            if len(rows) - n_acc:
+                obs_metrics.inc(
+                    "learned_warm_reject_total", len(rows) - n_acc, **labels
+                )
+            return seeds, accepted
+        except Exception:
+            try:
+                seeds = [self._nan_seed(r) for r in rows]
+                obs_metrics.inc(
+                    "learned_warm_reject_total", len(seeds),
+                    source=self.source, **({"entry": entry} if entry else {}),
+                )
+                return seeds, [False] * len(seeds)
+            except Exception:
+                return None, None
+
+    def seed_stacked(
+        self, rows: Sequence[Any], entry: Optional[str] = None
+    ) -> Optional[Tuple[np.ndarray, ...]]:
+        """`seed_rows` stacked into the batched ``warm_start=`` tuple the
+        adaptive entry points take (None -> caller stays cold)."""
+        seeds, _ = self.seed_rows(rows, entry=entry)
+        if not seeds:
+            return None
+        k = len(seeds[0])
+        return tuple(np.stack([s[j] for s in seeds]) for j in range(k))

@@ -1,0 +1,236 @@
+"""Per-family warm-start predictor training and versioned artifacts.
+
+The model is deliberately the same shape as the market surrogates
+(`surrogates/train.py`): a small sigmoid `SurrogateMLP` trained full-batch
+with Adam on standardized inputs/outputs — the training loop is literally
+`train_surrogate`. What this module adds is the *contract* around it:
+
+- a train/holdout split with holdout MSE / R² reported (a warm-start
+  artifact that only memorized its training sweep would poison serving);
+- a single-file ``.npz`` artifact carrying weights + scaling + the
+  feature schema + a **family-compatibility manifest** — the
+  `learn.dataset.family_fingerprint` of the LP family it was trained on,
+  the varying-field feature schema, the target layout, and the measured
+  cold-iteration baseline used for ``warm_start_iters_saved_total``
+  attribution;
+- refuse-to-load semantics: `WarmStartModel.load` raises
+  `ArtifactMismatch` on a version or family mismatch rather than serving
+  a predictor into the wrong program (the safeguard would reject its
+  seeds lane by lane, but a structurally wrong artifact is an operator
+  error worth surfacing loudly).
+
+Serving-side inference lives in `learn.predictor`.
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dataset import WarmStartDataset
+
+ARTIFACT_VERSION = 1
+
+_SCALE_KEYS = ("xm_inputs", "xstd_inputs", "xmin", "xmax", "y_mean", "y_std")
+
+
+class ArtifactMismatch(ValueError):
+    """A warm-start artifact whose version or family manifest does not
+    match what the caller is serving. Never caught into a silent cold
+    path by the loaders — mismatched artifacts are configuration errors."""
+
+
+class WarmStartModel:
+    """A trained per-family warm-start predictor plus its manifest.
+
+    ``manifest`` keys: ``version``, ``family``, ``problem_type``,
+    ``varying``, ``targets`` (``[[part, dim], ...]`` concatenation
+    layout), ``feature_dim``, ``target_dim``, ``hidden``,
+    ``cold_iters_mean`` (mean solver iterations over the training pairs —
+    the iters-saved baseline; None when the dataset carried no counts),
+    and ``metrics`` from training."""
+
+    def __init__(self, surrogate, manifest: Dict):
+        self.surrogate = surrogate
+        self.manifest = dict(manifest)
+
+    # -- manifest accessors -------------------------------------------
+    @property
+    def family(self) -> str:
+        return self.manifest["family"]
+
+    @property
+    def varying(self) -> Tuple[str, ...]:
+        return tuple(self.manifest["varying"])
+
+    @property
+    def targets(self) -> List[Tuple[str, int]]:
+        return [(str(n), int(d)) for n, d in self.manifest["targets"]]
+
+    @property
+    def problem_type(self) -> str:
+        return self.manifest.get("problem_type", "LPData")
+
+    @property
+    def feature_dim(self) -> int:
+        return int(self.manifest["feature_dim"])
+
+    @property
+    def cold_iters_mean(self) -> Optional[float]:
+        v = self.manifest.get("cold_iters_mean")
+        return None if v is None else float(v)
+
+    # -- inference -----------------------------------------------------
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """(batch, feature_dim) -> (batch, target_dim) host array."""
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2 or X.shape[1] != self.feature_dim:
+            raise ValueError(
+                f"feature shape {X.shape} does not match artifact "
+                f"feature_dim={self.feature_dim}"
+            )
+        out = np.asarray(self.surrogate.predict(X), np.float64)
+        return out.reshape(X.shape[0], -1)
+
+    def predict_parts(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        """Prediction split back into named iterate parts per the
+        manifest's target layout: ``{"x": (batch, n), "y": (batch, m),
+        ...}``."""
+        out = self.predict(X)
+        parts, off = {}, 0
+        for name, dim in self.targets:
+            parts[name] = out[:, off:off + dim]
+            off += dim
+        return parts
+
+    # -- persistence ---------------------------------------------------
+    def save(self, path: str) -> str:
+        """Single-file versioned artifact: ``__manifest__`` (JSON) +
+        ``scale/<k>`` arrays + ``w/<flattened-param-path>`` weights."""
+        import jax
+
+        flat = jax.tree_util.tree_flatten_with_path(self.surrogate.params)[0]
+        payload = {
+            "w/" + "/".join(str(p) for p in kp): np.asarray(v)
+            for kp, v in flat
+        }
+        for k in _SCALE_KEYS:
+            payload[f"scale/{k}"] = np.asarray(self.surrogate.scaling[k])
+        payload["__manifest__"] = np.asarray(json.dumps(self.manifest))
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        np.savez(path, **payload)
+        return path
+
+    @classmethod
+    def load(cls, path: str, expect_family: Optional[str] = None) -> "WarmStartModel":
+        """Reload an artifact; raises `ArtifactMismatch` when the version
+        is unknown or `expect_family` disagrees with the manifest."""
+        from ..surrogates.train import SurrogateMLP, TrainedSurrogate
+
+        with np.load(path, allow_pickle=False) as dat:
+            if "__manifest__" not in dat.files:
+                raise ArtifactMismatch(f"{path}: not a warm-start artifact")
+            manifest = json.loads(str(dat["__manifest__"]))
+            weights = {
+                k[2:]: np.asarray(dat[k])
+                for k in dat.files if k.startswith("w/")
+            }
+            scaling = {
+                k.split("/", 1)[1]: np.asarray(dat[k])
+                for k in dat.files if k.startswith("scale/")
+            }
+        ver = manifest.get("version")
+        if ver != ARTIFACT_VERSION:
+            raise ArtifactMismatch(
+                f"{path}: artifact version {ver!r}, this build reads "
+                f"{ARTIFACT_VERSION}"
+            )
+        if expect_family is not None and manifest.get("family") != expect_family:
+            raise ArtifactMismatch(
+                f"{path}: trained for family {manifest.get('family')!r:.24}..., "
+                f"caller is serving family {expect_family!r:.24}..."
+            )
+        missing = [k for k in _SCALE_KEYS if k not in scaling]
+        if missing or not weights:
+            raise ArtifactMismatch(
+                f"{path}: artifact missing {missing or ['weights']}"
+            )
+        params = _unflatten(weights)
+        model = SurrogateMLP(
+            hidden=tuple(manifest["hidden"]),
+            out_dim=int(manifest["target_dim"]),
+        )
+        scl = {k: v.tolist() for k, v in scaling.items()}
+        return cls(TrainedSurrogate(model, params, scl), manifest)
+
+
+def _unflatten(flat: Dict[str, np.ndarray]):
+    """Invert the `tree_flatten_with_path` key join used by `save`: keys
+    look like ``['params']/['Dense_0']/['kernel']`` (one `DictKey` repr
+    per path component)."""
+    import jax.numpy as jnp
+
+    tree: Dict = {}
+    for key, arr in flat.items():
+        parts = [
+            m.group(1) for m in re.finditer(r"\['([^']+)'\]", key)
+        ] or key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(arr)
+    return tree
+
+
+def train_warmstart_model(
+    dataset: WarmStartDataset,
+    *,
+    hidden: Sequence[int] = (64, 64),
+    epochs: int = 300,
+    lr: float = 1e-3,
+    seed: int = 0,
+    holdout_frac: float = 0.2,
+    verbose: bool = False,
+) -> Tuple[WarmStartModel, Dict]:
+    """Train one per-family predictor: split, run the
+    `surrogates.train.train_surrogate` loop on the train rows, score the
+    holdout, and wrap the result with its compatibility manifest. Returns
+    ``(model, metrics)`` with ``metrics = {"rows_train", "rows_holdout",
+    "train_R2_mean", "holdout_mse", "holdout_rel_err", "cold_iters_mean"}``."""
+    from ..surrogates.train import train_surrogate
+
+    train, hold = dataset.split(holdout_frac=holdout_frac, seed=seed)
+    sur, train_metrics = train_surrogate(
+        train.X, train.Y, hidden=tuple(hidden), epochs=epochs, lr=lr,
+        seed=seed, verbose=verbose,
+    )
+    metrics: Dict = {
+        "rows_train": len(train),
+        "rows_holdout": len(hold),
+        "train_R2_mean": float(np.mean(np.asarray(train_metrics["R2"]))),
+    }
+    if len(hold):
+        pred = np.asarray(sur.predict(hold.X), np.float64)
+        err = pred - hold.Y
+        metrics["holdout_mse"] = float(np.mean(err**2))
+        metrics["holdout_rel_err"] = float(
+            np.linalg.norm(err) / (1.0 + np.linalg.norm(hold.Y))
+        )
+    cold = dataset.cold_iters_mean()
+    metrics["cold_iters_mean"] = cold
+    manifest = {
+        "version": ARTIFACT_VERSION,
+        "family": dataset.family,
+        "problem_type": dataset.problem_type,
+        "varying": list(dataset.varying),
+        "targets": [[n, d] for n, d in dataset.targets],
+        "feature_dim": int(dataset.X.shape[1]),
+        "target_dim": int(dataset.Y.shape[1]),
+        "hidden": list(int(h) for h in hidden),
+        "cold_iters_mean": cold,
+        "metrics": metrics,
+    }
+    return WarmStartModel(sur, manifest), metrics

@@ -95,6 +95,7 @@ from .recorder import (  # noqa: F401
     load_capture,
     maybe_capture,
     set_recorder,
+    warm_bundle,
 )
 from .reqtrace import (  # noqa: F401
     TRACEPARENT_ENV,
@@ -191,6 +192,7 @@ __all__ = [
     "set_recorder",
     "maybe_capture",
     "load_capture",
+    "warm_bundle",
     "WatchdogTimeout",
     "with_watchdog",
     "describe",

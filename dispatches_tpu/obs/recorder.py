@@ -189,6 +189,51 @@ class FlightRecorder:
             return None
 
 
+_WARM_PARTS = {4: ("x", "y", "zl", "zu"), 2: ("x", "y")}
+
+
+def warm_bundle(problem: Any, warm_start: Any) -> Optional[Dict[str, Any]]:
+    """Capture bundle for a solver warm seed (learned or neighbor).
+
+    The RAW parts (``x``/``y``/``zl``/``zu``, or ``x``/``y`` for PDHG)
+    are what replay re-feeds through ``warm_start=`` — the solver
+    re-applies its own clip + per-lane rejection safeguard, so a
+    learned-warm failure reproduces bitwise. For dense IPM problems the
+    bundle also records the APPLIED seed (post-clip, solution frame) and
+    the safeguard's accept verdict via
+    `solvers.ipm.apply_warm_safeguard`, so a post-mortem can see what
+    the solver actually started from without rerunning it. Returns None
+    for no warm start; never raises."""
+    if warm_start is None:
+        return None
+    try:
+        if isinstance(warm_start, dict):
+            return {str(k): np.asarray(v) for k, v in warm_start.items()}
+        parts = _WARM_PARTS.get(len(warm_start))
+        if parts is None:
+            return {
+                f"part{i}": np.asarray(v) for i, v in enumerate(warm_start)
+            }
+        bundle = {k: np.asarray(v) for k, v in zip(parts, warm_start)}
+        if (
+            type(problem).__name__ == "LPData"
+            and len(warm_start) == 4
+            and np.asarray(bundle["x"]).ndim <= 1
+        ):
+            from ..solvers.ipm import apply_warm_safeguard
+
+            applied, ok = apply_warm_safeguard(problem, warm_start)
+            for k, v in zip(parts, applied):
+                bundle[f"applied_{k}"] = np.asarray(v)
+            bundle["accepted"] = np.asarray(ok)
+        return bundle
+    except Exception:
+        try:
+            return {str(k): np.asarray(v) for k, v in warm_start.items()}
+        except Exception:
+            return None
+
+
 def load_capture(path: str) -> dict:
     """Reload a capture: meta.json plus the arrays, with the problem
     NamedTuple reconstructed when its type is known. `path` may be the

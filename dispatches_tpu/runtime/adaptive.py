@@ -460,6 +460,7 @@ class SlotEngine:
         shared: Optional[dict] = None,
         trace: bool = False,
         opt_key=(),
+        warm_fn=None,
     ):
         if bucket <= 0:
             raise ValueError(f"bucket must be positive (got {bucket})")
@@ -477,6 +478,16 @@ class SlotEngine:
         self._done_flag = done_flag or (
             lambda st: np.asarray(st.done) | (np.asarray(st.it) >= self.max_iter)
         )
+        # learned warm starts (learn/predictor.py): when set, the cold
+        # dispatch seeds fresh lanes from `warm_fn(rows)` — the segments
+        # must then be built with a warm in-axis (`make_dense_engine`
+        # handles this). With warm_fn None nothing below changes and the
+        # cold dispatch passes the historical `None` warm argument, so
+        # predictor-off stays bitwise-identical executable-for-executable.
+        self._warm_fn = warm_fn
+        self._warm_buf = None  # (bucket, ...) host seed mirror per part
+        self._warm_src = [None] * bucket  # slot -> seed source label
+        self._warm_ok = [False] * bucket  # slot -> safeguard accept verdict
         self._tokens = [None] * bucket  # slot -> caller token (None = idle)
         self._fresh = [False] * bucket  # needs cold state before next resume
         self._st = None  # carried device state pytree
@@ -526,6 +537,8 @@ class SlotEngine:
                     if buf is not None:
                         buf[i] = r
                 self._fresh[i] = True
+                self._warm_src[i] = None
+                self._warm_ok[i] = False
                 self._it_mark[i] = 0
                 self._dirty = True
                 if self._st is not None:
@@ -551,6 +564,8 @@ class SlotEngine:
         # (its stop mark goes to 0, so it is frozen); no restack needed
         self._tokens[i] = None
         self._fresh[i] = False
+        self._warm_src[i] = None
+        self._warm_ok[i] = False
 
     # -- the chunk step ------------------------------------------------
     _sol_dev = None  # last chunk's on-device solution tree
@@ -587,6 +602,60 @@ class SlotEngine:
             self._scatter_fn = jax.jit(_sc)
         return self._scatter_fn
 
+    def _row_problem(self, i: int):
+        """One slot's problem NamedTuple rebuilt from the host mirror."""
+        return self.fields(*(
+            self.shared[name] if name in self.shared else buf[i]
+            for name, buf in zip(self.fields._fields, self._buf)
+        ))
+
+    def _warm_seeds(self):
+        """Per-part ``(bucket, ...)`` warm arrays for the cold dispatch.
+        Fresh occupied slots get predictor seeds from `warm_fn` (NaN
+        seeds when the predictor degrades — the solver safeguard rejects
+        those per lane, landing bitwise on the cold start); every other
+        row keeps whatever the seed buffer holds, since non-fresh rows'
+        cold states are discarded by the fresh-row scatter anyway."""
+        import jax.numpy as jnp
+
+        fresh = [
+            i for i, (f, t) in enumerate(zip(self._fresh, self._tokens))
+            if f and t is not None
+        ]
+        rows = [self._row_problem(i) for i in fresh]
+        seeds, accepted = self._warm_fn(rows)
+        src = getattr(self._warm_fn, "source", "learned")
+        if seeds is None:
+            # no layout known: synthesize solver-rejected NaN seeds from
+            # the lane data itself (IPM 4-tuple / PDHG 2-tuple)
+            def _nan(row):
+                dtype = np.asarray(row.b).dtype
+                n = int(np.asarray(row.c).shape[-1])
+                m = int(np.asarray(row.b).shape[-1])
+                parts = (n, m) if type(row).__name__ == "SparseLP" \
+                    else (n, m, n, n)
+                return tuple(np.full((k,), np.nan, dtype) for k in parts)
+
+            seeds = [_nan(r) for r in rows]
+            accepted = None
+        if self._warm_buf is None:
+            self._warm_buf = [
+                np.zeros((self.bucket,) + s.shape, s.dtype)
+                for s in seeds[0]
+            ]
+        for j, i in enumerate(fresh):
+            ok = bool(accepted[j]) if accepted else False
+            for buf, part in zip(self._warm_buf, seeds[j]):
+                part = np.asarray(part)
+                if part.shape == buf.shape[1:]:
+                    buf[i] = part
+                else:  # malformed custom warm_fn seed: reject, not crash
+                    buf[i] = np.nan
+                    ok = False
+            self._warm_src[i] = src
+            self._warm_ok[i] = ok
+        return tuple(jnp.asarray(b) for b in self._warm_buf)
+
     def _stack(self):
         import jax.numpy as jnp
 
@@ -620,7 +689,8 @@ class SlotEngine:
                            self.opt_key))
             if self._zero_stops is None:
                 self._zero_stops = jnp.zeros((self.bucket,), jnp.int32)
-            _, st0 = self.seg_cold(self._d_cur, None, self._zero_stops)
+            w_arg = self._warm_seeds() if self._warm_fn is not None else None
+            _, st0 = self.seg_cold(self._d_cur, w_arg, self._zero_stops)
             # the very first chunk routes through the same scatter as
             # every later one (sel = all rows), so the carried tree's
             # avals never change and resume compiles exactly once
@@ -673,7 +743,23 @@ class SlotEngine:
                 if token is None or not finished[i]:
                     continue
                 row = type(sol)(*(leaf[i] for leaf in sol_np))
-                out.append((token, row, {"iterations": int(its[i])}))
+                lane_stats = {"iterations": int(its[i])}
+                src = self._warm_src[i]
+                if src is not None:
+                    lane_stats["warm_source"] = src
+                    lane_stats["warm_accepted"] = bool(self._warm_ok[i])
+                    base = getattr(self._warm_fn, "iters_baseline", None)
+                    if self._warm_ok[i] and base:
+                        # credit against the artifact's measured cold
+                        # baseline — the serve path never runs the same
+                        # lane cold, so the counterfactual is statistical
+                        saved = max(0.0, float(base) - float(its[i]))
+                        if saved > 0:
+                            obs_metrics.inc(
+                                "warm_start_iters_saved_total", saved,
+                                source=src, entry=self.entry,
+                            )
+                out.append((token, row, lane_stats))
                 self._release(i)
                 retired += 1
         if retired:
@@ -691,6 +777,7 @@ def make_dense_engine(
     *,
     chunk_iters: int = 8,
     trace: bool = False,
+    warm_predictor=None,
     **solver_kw,
 ) -> "SlotEngine":
     """One dense-LP `SlotEngine` at `bucket` lanes — the construction
@@ -698,23 +785,79 @@ def make_dense_engine(
     and the fleet's shard child (`serve.shard`), so both paths compile
     identical cold/resume executables and the bitwise contract holds
     across the process boundary. `solver_kw` flows to `solve_lp_partial`
-    (`max_iter` also bounds the engine's per-lane budget)."""
+    (`max_iter` also bounds the engine's per-lane budget).
+
+    `warm_predictor` (a `learn.WarmStartPredictor`, a `WarmStartModel`,
+    or an artifact path) seeds every admitted lane through the
+    safeguarded warm-start path; with it None (the default) the engine —
+    segments, compile keys, and solution bits — is exactly the
+    historical one."""
     from ..core.program import LPData
 
     solver_kw.setdefault("max_iter", 60)
     d_axes = LPData(*(0,) * len(LPData._fields))
+    warm_fn = None
+    w_ax = None
+    opt_key = _opt_key(solver_kw)
+    if warm_predictor is not None:
+        from ..learn.predictor import WarmStartPredictor
+
+        if not isinstance(warm_predictor, WarmStartPredictor):
+            warm_predictor = WarmStartPredictor(warm_predictor)
+
+        def warm_fn(rows, _p=warm_predictor):
+            return _p.seed_rows(rows, entry="serve_dense")
+
+        warm_fn.source = warm_predictor.source
+        warm_fn.iters_baseline = warm_predictor.cold_iters_mean
+        w_ax = 0
+        # the warm engine compiles different executables; keep its compile
+        # accounting distinct from the cold engine's
+        opt_key = opt_key + (("warm_model", warm_predictor.model.family[:12]),)
     seg_cold, seg_resume = dense_segments(
-        d_axes, None, trace, solver_kw, stop_axis=0
+        d_axes, w_ax, trace, solver_kw, stop_axis=0
     )
     return SlotEngine(
         "serve_dense", LPData, seg_cold, seg_resume, bucket,
         chunk_iters=chunk_iters, max_iter=solver_kw["max_iter"],
-        trace=trace, opt_key=_opt_key(solver_kw),
+        trace=trace, opt_key=opt_key, warm_fn=warm_fn,
     )
 
 
 # ---------------------------------------------------------------------------
 # entry points
+
+
+def _predict_warm(predictor, fields_cls, data, axes, batch, entry):
+    """Seeds for an adaptive entry from a `learn.WarmStartPredictor`:
+    unstack the batch into single-lane rows (the predictor's unit of
+    account), let it seed them, restack into the ``warm_start=`` tuple.
+    Returns None on any degradation — the entry then runs plainly cold,
+    which is the historical (bitwise-unchanged) path."""
+    try:
+        if batch is None:
+            rows = [fields_cls(*(np.asarray(a) for a in data))]
+        else:
+            cols = [
+                np.asarray(a) if ax == 0 else a
+                for a, ax in zip(data, axes)
+            ]
+            rows = [
+                fields_cls(*(
+                    c[k] if ax == 0 else np.asarray(c)
+                    for c, ax in zip(cols, axes)
+                ))
+                for k in range(batch)
+            ]
+        seeds, _accepted = predictor.seed_rows(rows, entry=entry)
+        if not seeds:
+            return None
+        if batch is None:
+            return seeds[0]
+        k = len(seeds[0])
+        return tuple(np.stack([s[j] for s in seeds]) for j in range(k))
+    except Exception:
+        return None
 
 
 def _batch_axes(fields_cls, base_ndim, data):
@@ -737,6 +880,7 @@ def solve_lp_adaptive(
     chunk_iters: int = 8,
     ladder_base: int = 8,
     warm_start=None,
+    warm_predictor=None,
     trace: bool = False,
     stats: Optional[dict] = None,
     **solver_kw,
@@ -751,7 +895,12 @@ def solve_lp_adaptive(
     ``(IPMSolution, SolveTrace)``, the stitched traces equal to the
     one-shot traces. `stats`, when a dict, is filled with the driver's
     chunk/bucket/retirement/compile accounting for journal attachment.
-    Unbatched input falls back to the plain solve."""
+    Unbatched input falls back to the plain solve.
+
+    `warm_predictor` (a `learn.WarmStartPredictor`) seeds lanes when no
+    explicit `warm_start` is given; its seeds flow through the same
+    per-lane safeguard, and any predictor degradation falls back to the
+    plain cold path (bitwise-identical to omitting it)."""
     import jax
 
     from ..core.program import LPData
@@ -759,6 +908,10 @@ def solve_lp_adaptive(
 
     base_ndim = {"A": 2, "b": 1, "c": 1, "l": 1, "u": 1, "c0": 0}
     axes, batch = _batch_axes(LPData, base_ndim, lp)
+    if warm_start is None and warm_predictor is not None:
+        warm_start = _predict_warm(
+            warm_predictor, LPData, lp, axes, batch, "solve_lp"
+        )
     if batch is None:
         return solve_lp(lp, warm_start=warm_start, trace=trace, **solver_kw)
     max_iter = solver_kw.get("max_iter", 60)
@@ -798,12 +951,14 @@ def solve_lp_banded_adaptive(
     chunk_iters: int = 8,
     ladder_base: int = 8,
     warm_start=None,
+    warm_predictor=None,
     trace: bool = False,
     stats: Optional[dict] = None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.structured.solve_lp_banded_batch`
-    (same contract as `solve_lp_adaptive`; the year-scenario path)."""
+    (same contract as `solve_lp_adaptive`, including `warm_predictor`
+    seeding with cold-path fallback; the year-scenario path)."""
     import jax
 
     from ..solvers.ipm import IPMSolution
@@ -814,6 +969,10 @@ def solve_lp_banded_adaptive(
         "l": 2, "u": 2, "lb": 1, "ub": 1, "c0": 0,
     }
     axes, batch = _batch_axes(BandedLP, base_ndim, blp)
+    if warm_start is None and warm_predictor is not None:
+        warm_start = _predict_warm(
+            warm_predictor, BandedLP, blp, axes, batch, "solve_lp_banded"
+        )
     if batch is None:
         return solve_lp_banded(
             meta, blp, warm_start=warm_start, trace=trace, **solver_kw
@@ -859,6 +1018,7 @@ def solve_lp_pdhg_adaptive(
     chunk_iters: int = 2000,
     ladder_base: int = 8,
     warm_start=None,
+    warm_predictor=None,
     trace: bool = False,
     stats: Optional[dict] = None,
     **solver_kw,
@@ -866,7 +1026,9 @@ def solve_lp_pdhg_adaptive(
     """Adaptive-batch PDHG over a batch of `SparseLP`s sharing one
     sparsity pattern (batched ``vals``/``b``/``c``/bounds; ``rows`` and
     ``cols`` broadcast). Same retirement/compaction contract as
-    `solve_lp_adaptive`; `chunk_iters` is rounded up to a whole number of
+    `solve_lp_adaptive` (including `warm_predictor` — PDHG seeds are the
+    ``(x, y)`` slice of the prediction, projected/finiteness-checked by
+    the solver); `chunk_iters` is rounded up to a whole number of
     convergence-check periods (`check_every`), since the PDHG outer loop
     only observes the counter between checks."""
     import jax
@@ -879,6 +1041,10 @@ def solve_lp_pdhg_adaptive(
         "c0": 0,
     }
     axes, batch = _batch_axes(SparseLP, base_ndim, lps)
+    if warm_start is None and warm_predictor is not None:
+        warm_start = _predict_warm(
+            warm_predictor, SparseLP, lps, axes, batch, "solve_lp_pdhg"
+        )
     if batch is None:
         return solve_lp_pdhg(
             lps, warm_start=warm_start, trace=trace, **solver_kw

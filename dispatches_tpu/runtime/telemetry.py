@@ -97,6 +97,9 @@ class SolveTelemetry:
             _metrics.observe("solve_wall_seconds", wall, solve=name)
             _recorder.maybe_capture(
                 name, verdict="failed", problem=problem,
+                warm_start=_recorder.warm_bundle(
+                    problem, kwargs.get("warm_start")
+                ),
                 extra={"error": f"{type(e).__name__}: {e}"},
             )
             self.records.append(
@@ -148,6 +151,9 @@ class SolveTelemetry:
                 if worst != "healthy":
                     _recorder.maybe_capture(
                         name, verdict=worst_v, problem=problem, solution=sol,
+                        warm_start=_recorder.warm_bundle(
+                            problem, kwargs.get("warm_start")
+                        ),
                     )
         except Exception:
             pass  # diagnosis must never kill the solve it observes

@@ -304,6 +304,10 @@ class FleetService:
                     req, row, msg.get("iterations"),
                     shard=slot.shard.shard_id, child_slot=msg.get("slot"),
                     journey=msg.get("journey"),
+                    warm_attrs={
+                        k: msg[k]
+                        for k in ("warm_source", "warm_accepted") if k in msg
+                    },
                 )
                 done += 1
         return done
@@ -655,7 +659,8 @@ class FleetService:
         ))
 
     def _resolve_solved(
-        self, req, row, iterations, *, shard: int, child_slot, journey=None
+        self, req, row, iterations, *, shard: int, child_slot, journey=None,
+        warm_attrs=None,
     ) -> None:
         self.completed += 1
         now = self.clock()
@@ -691,6 +696,7 @@ class FleetService:
             self.name, row,
             request_id=req.request_id, seq=req.seq,
             latency_s=latency, iterations=iterations, shard=shard,
+            **(warm_attrs or {}),
         )
         if req.journey is not None:
             # started_at re-stamps on every dispatch, so a requeued
@@ -866,6 +872,7 @@ def make_dense_fleet(
     telemetry: bool = False,
     stderr_dir: Optional[str] = None,
     spawn: bool = True,
+    warm_model: Optional[str] = None,
     **fleet_kw,
 ) -> FleetService:
     """A `FleetService` of `n_shards` dense-LP shard processes, each
@@ -879,7 +886,10 @@ def make_dense_fleet(
     journal deltas ride the heartbeat back into the parent registry);
     ``reqtrace=True`` additionally makes children attach chunk-loop
     journey marks to result frames. Both off by default and
-    bitwise-neutral for solve results."""
+    bitwise-neutral for solve results. `warm_model` (an artifact path
+    from tools/train_warmstart.py; default None = today's cold path)
+    makes every child seed cold dispatches through the solver's
+    safeguarded learned warm-start plumbing."""
     import os
 
     from ..parallel.mesh import shard_device_env
@@ -899,6 +909,7 @@ def make_dense_fleet(
             ),
             telemetry=telemetry,
             reqtrace=reqtrace,
+            warm_model=warm_model,
         )
         for i in range(n_shards)
     ]

@@ -366,10 +366,15 @@ class DispatchService:
             "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
             status="ok",
         )
+        warm_attrs = {
+            k: stats[k]
+            for k in ("warm_source", "warm_accepted") if k in stats
+        }
         get_tracer().solve_event(
             self.name, row,
             request_id=req.request_id, seq=req.seq,
             latency_s=latency, iterations=stats.get("iterations"),
+            **warm_attrs,
         )
         if req.journey is not None:
             req.journey.finish(
@@ -484,16 +489,23 @@ def make_dense_service(
     clock=time.monotonic,
     trace: bool = False,
     reqtrace: bool = False,
+    warm_model=None,
     **solver_kw,
 ) -> DispatchService:
     """A `DispatchService` over dense `LPData` rows solved by the IPM:
     one `SlotEngine` at `bucket` lanes, solver options passed through to
     `solve_lp_partial` (`max_iter` also bounds the engine's per-lane
-    budget). Every submitted row must share shapes (M, N)."""
+    budget). Every submitted row must share shapes (M, N).
+
+    `warm_model` (default None = today's cold path, bitwise-identical)
+    is a learned warm-start artifact path / `WarmStartModel` /
+    `WarmStartPredictor`; cold dispatches are then seeded through the
+    solver's safeguarded ``warm_start=`` plumbing."""
     from ..runtime.adaptive import make_dense_engine
 
     engine = make_dense_engine(
-        bucket, chunk_iters=chunk_iters, trace=trace, **solver_kw
+        bucket, chunk_iters=chunk_iters, trace=trace,
+        warm_predictor=warm_model, **solver_kw
     )
     cache = ResultCache(cache_size) if cache_size else None
     return DispatchService(

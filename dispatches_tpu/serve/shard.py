@@ -282,6 +282,9 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                     help="ship metrics/journal deltas on heartbeat pongs")
     ap.add_argument("--reqtrace", type=int, default=0,
                     help="attach chunk-loop journey marks to result frames")
+    ap.add_argument("--warm-model", default=None,
+                    help="learned warm-start artifact (learn/) seeding "
+                         "cold dispatches through the solver safeguard")
     args = ap.parse_args(argv)
 
     if os.environ.get(DIE_ON_START_ENV) == "1":
@@ -350,7 +353,8 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
 
     solver_kw = json.loads(args.solver_kw)
     engine = make_dense_engine(
-        args.bucket, chunk_iters=args.chunk_iters, **solver_kw
+        args.bucket, chunk_iters=args.chunk_iters,
+        warm_predictor=args.warm_model, **solver_kw
     )
 
     journeys: Optional[_LaneJourneys] = None
@@ -462,6 +466,10 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                     if np.asarray(leaf).dtype.kind == "f" else leaf
                     for leaf in row
                 ))
+            warm_attrs = {
+                k: stats[k]
+                for k in ("warm_source", "warm_accepted") if k in stats
+            }
             if tracer is not None:
                 # child-side health verdict with shard provenance; rides
                 # the next telemetry frame into the parent journal
@@ -469,6 +477,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                     "shard_engine", row, lane=lane,
                     iterations=stats.get("iterations"),
                     shard=args.shard_id,
+                    **warm_attrs,
                 )
             frame = {
                 "op": "result",
@@ -476,6 +485,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 "slot": slot,
                 "iterations": stats.get("iterations"),
                 "row": encode_row(row),
+                **warm_attrs,
             }
             if journeys is not None:
                 j = journeys.pop(lane)
@@ -511,11 +521,13 @@ class ShardProcess:
         stderr_path: Optional[str] = None,
         telemetry: bool = False,
         reqtrace: bool = False,
+        warm_model: Optional[str] = None,
     ):
         self.shard_id = int(shard_id)
         self.bucket = int(bucket)
         self.chunk_iters = int(chunk_iters)
         self.solver_kw = dict(solver_kw or {})
+        self.warm_model = warm_model
         self.device_env = dict(device_env or {})
         self.extra_env = dict(extra_env or {})
         self.stderr_path = stderr_path
@@ -554,6 +566,8 @@ class ShardProcess:
             "--telemetry", "1" if self.telemetry else "0",
             "--reqtrace", "1" if self.reqtrace else "0",
         ]
+        if self.warm_model:
+            cmd += ["--warm-model", os.path.abspath(self.warm_model)]
         env = dict(os.environ)
         # the child must import dispatches_tpu no matter the parent's cwd
         pkg_root = os.path.dirname(os.path.dirname(

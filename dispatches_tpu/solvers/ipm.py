@@ -305,6 +305,132 @@ def _solve_lp_inner(lp, tol, max_iter, reg_p, reg_d, refine_steps, q, stall_limi
     return sol_out, tr
 
 
+def _warm_safeguard(warm, fl, fu, l_s, u_s, dtype):
+    """Safeguarded warm start (PR 4): clip the seed strictly interior,
+    then reject it wholesale if clipping moved any coordinate by more
+    than 10% of its bound range (relative for one-sided bounds) or the
+    seed is nonfinite — such a shift means the seeding solution's active
+    set disagrees and the cold start converges faster. Operates in the
+    SCALED frame on a single lane (vmap handles batches). Returns the
+    clipped iterate pieces plus the per-lane accept flag ``ok_w``; the
+    caller blends with the cold start via ``jnp.where(ok_w, ...)``.
+    Extracted from `_solve_scaled` verbatim so `warm_start_accept` can
+    report the same verdict the solver will use."""
+    both = fl & fu
+    xw, yw, zlw, zuw = (jnp.asarray(a, dtype) for a in warm)
+    width = u_s - l_s
+    marg = jnp.where(both, jnp.minimum(1e-4, 0.25 * width), 1e-4)
+    lo = jnp.where(fl, l_s + marg, -jnp.inf)
+    hi = jnp.where(fu, u_s - marg, jnp.inf)
+    x_w = jnp.clip(xw, lo, hi)
+    z_floor = jnp.asarray(1e-4, dtype)
+    zl_w = jnp.where(fl, jnp.maximum(zlw, z_floor), 0.0)
+    zu_w = jnp.where(fu, jnp.maximum(zuw, z_floor), 0.0)
+    denom = jnp.where(both, jnp.maximum(width, 1e-8), 1.0 + jnp.abs(xw))
+    shifted = jnp.where(fl | fu, jnp.abs(x_w - xw) / denom, 0.0)
+    finite_w = (
+        jnp.all(jnp.isfinite(xw))
+        & jnp.all(jnp.isfinite(yw))
+        & jnp.all(jnp.isfinite(zl_w))
+        & jnp.all(jnp.isfinite(zu_w))
+    )
+    ok_w = finite_w & (jnp.max(shifted, initial=0.0) <= 0.1)
+    return x_w, yw, zl_w, zu_w, ok_w
+
+
+def warm_start_accept(lp, warm_start):
+    """Would the safeguard ACCEPT this solution-frame seed for this LP?
+
+    Replays the exact scaling prologue of `_solve_lp_inner` (Ruiz
+    equilibration + sigma normalization + the warm-seed frame map) and
+    the `_warm_safeguard` clip/reject test, returning the boolean the
+    solver itself will compute — without running any iterations. Pure
+    observability: the learned-warm-start serving path uses it to count
+    accepts/rejects (`learned_warm_accept_total`), never to gate the
+    solve (the solver re-applies the safeguard internally either way).
+    One lane; `jax.vmap` over `(lp, warm_start)` for a batch."""
+    A0, b0, c0v, l0, u0, _ = lp
+    dtype = b0.dtype
+    r, cs = _ruiz_scaling(A0)
+    b = b0 * r
+    l = l0 / cs
+    u = u0 / cs
+    c = c0v * cs
+    sig_c = jnp.maximum(1.0, jnp.max(jnp.abs(c)))
+    sig_b = jnp.maximum(
+        1.0,
+        jnp.maximum(
+            jnp.max(jnp.abs(b), initial=0.0),
+            jnp.max(jnp.where(jnp.isfinite(l), jnp.abs(l), 0.0)),
+        ),
+    )
+    xw, yw, zlw, zuw = warm_start
+    warm_s = (
+        xw / (cs * sig_b),
+        yw / (r * sig_c),
+        zlw * cs / sig_c,
+        zuw * cs / sig_c,
+    )
+    l_sc = l / sig_b
+    u_sc = u / sig_b
+    fl = jnp.isfinite(l_sc)
+    fu = jnp.isfinite(u_sc)
+    l_s = jnp.where(fl, l_sc, 0.0)
+    u_s = jnp.where(fu, u_sc, 0.0)
+    *_, ok_w = _warm_safeguard(warm_s, fl, fu, l_s, u_s, dtype)
+    return ok_w
+
+
+def apply_warm_safeguard(lp, warm_start):
+    """The safeguard's *applied* seed in the solution frame: the
+    clipped/floored iterate the solver will actually start from when it
+    accepts, or `None`-equivalent semantics via the accept flag when it
+    rejects. Returns ``((x, y, zl, zu), accepted)`` with arrays in the
+    SOLUTION frame (mapped back through the same unscaling as solver
+    output). Used by the flight recorder to capture what a warm-started
+    failure actually ran with, so replays and post-mortems see the
+    post-clip seed, not just the raw prediction. One lane; vmap for a
+    batch."""
+    A0, b0, c0v, l0, u0, _ = lp
+    dtype = b0.dtype
+    r, cs = _ruiz_scaling(A0)
+    b = b0 * r
+    l = l0 / cs
+    u = u0 / cs
+    c = c0v * cs
+    sig_c = jnp.maximum(1.0, jnp.max(jnp.abs(c)))
+    sig_b = jnp.maximum(
+        1.0,
+        jnp.maximum(
+            jnp.max(jnp.abs(b), initial=0.0),
+            jnp.max(jnp.where(jnp.isfinite(l), jnp.abs(l), 0.0)),
+        ),
+    )
+    xw, yw, zlw, zuw = warm_start
+    warm_s = (
+        xw / (cs * sig_b),
+        yw / (r * sig_c),
+        zlw * cs / sig_c,
+        zuw * cs / sig_c,
+    )
+    l_sc = l / sig_b
+    u_sc = u / sig_b
+    fl = jnp.isfinite(l_sc)
+    fu = jnp.isfinite(u_sc)
+    l_s = jnp.where(fl, l_sc, 0.0)
+    u_s = jnp.where(fu, u_sc, 0.0)
+    x_w, yw_s, zl_w, zu_w, ok_w = _warm_safeguard(
+        warm_s, fl, fu, l_s, u_s, dtype
+    )
+    applied = (
+        x_w * cs * sig_b,
+        yw_s * r * sig_c,
+        zl_w / cs * sig_c,
+        zu_w / cs * sig_c,
+    )
+    return applied, ok_w
+
+
 def _solve_scaled(
     lp: LPData,
     tol: float = 1e-8,
@@ -397,29 +523,7 @@ def _solve_scaled(
     z0u = jnp.where(fu, 1.0, 0.0).astype(dtype)
 
     if warm is not None:
-        # Safeguarded warm start: clip the seed strictly interior, then
-        # reject it wholesale if clipping moved any coordinate by more
-        # than 10% of its bound range (relative for one-sided bounds) or
-        # the seed is nonfinite — such a shift means the neighbor's
-        # active set disagrees and the cold start converges faster.
-        xw, yw, zlw, zuw = (jnp.asarray(a, dtype) for a in warm)
-        width = u_s - l_s
-        marg = jnp.where(both, jnp.minimum(1e-4, 0.25 * width), 1e-4)
-        lo = jnp.where(fl, l_s + marg, -jnp.inf)
-        hi = jnp.where(fu, u_s - marg, jnp.inf)
-        x_w = jnp.clip(xw, lo, hi)
-        z_floor = jnp.asarray(1e-4, dtype)
-        zl_w = jnp.where(fl, jnp.maximum(zlw, z_floor), 0.0)
-        zu_w = jnp.where(fu, jnp.maximum(zuw, z_floor), 0.0)
-        denom = jnp.where(both, jnp.maximum(width, 1e-8), 1.0 + jnp.abs(xw))
-        shifted = jnp.where(fl | fu, jnp.abs(x_w - xw) / denom, 0.0)
-        finite_w = (
-            jnp.all(jnp.isfinite(xw))
-            & jnp.all(jnp.isfinite(yw))
-            & jnp.all(jnp.isfinite(zl_w))
-            & jnp.all(jnp.isfinite(zu_w))
-        )
-        ok_w = finite_w & (jnp.max(shifted, initial=0.0) <= 0.1)
+        x_w, yw, zl_w, zu_w, ok_w = _warm_safeguard(warm, fl, fu, l_s, u_s, dtype)
         x0 = jnp.where(ok_w, x_w, x0)
         y0 = jnp.where(ok_w, yw, y0)
         z0l = jnp.where(ok_w, zl_w, z0l)

@@ -453,7 +453,8 @@ def run_year_sweep(
                     saved = cold_iter_mean * len(todo) - float(iters_b.sum())
                     if saved > 0:
                         obs_metrics.inc("warm_start_iters_saved_total",
-                                        saved, runner="yearsweep")
+                                        saved, runner="yearsweep",
+                                        source="neighbor")
                 if warm_starts:
                     prev_sols = (
                         np.asarray(scales)[padded],
@@ -486,6 +487,9 @@ def run_year_sweep(
                                 ),
                                 problem=blp_b,
                                 solution=sol,
+                                warm_start=obs_recorder.warm_bundle(
+                                    blp_b, warm_b
+                                ),
                                 options={**solver_kw, "block_hours": block_hours},
                                 extra={"scenarios": [int(k) for k in todo]},
                             )

@@ -1,0 +1,294 @@
+"""Learned warm-start subsystem (dispatches_tpu/learn) safety tests.
+
+The subsystem's load-bearing promise is negative: a prediction can only
+ever help, never change an answer. Every adversarial artifact below —
+NaN output, absurdly large output, wrong-shape output, a predictor that
+raises — must land **bitwise** on the cold path through the solver's
+per-lane wholesale-rejection safeguard, and a predictor-disabled run
+must be bitwise-identical to the historical cold path. The positive
+side (a well-trained artifact actually saving iterations end-to-end) is
+exercised by `tools/train_warmstart.py --self-check` in CI; here one
+small trained model doubles as the rigging base for the adversaries.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.learn import (
+    ArtifactMismatch,
+    DatasetWriter,
+    WarmStartModel,
+    WarmStartPredictor,
+    family_fingerprint,
+    features_of,
+    load_dataset,
+    train_warmstart_model,
+)
+from dispatches_tpu.obs import metrics as obs_metrics
+from dispatches_tpu.solvers.ipm import solve_lp
+
+N, M = 8, 4
+_A = np.random.default_rng(7).standard_normal((M, N))
+
+
+def _problem(seed, A=_A):
+    """One member of the synthetic LP family: fixed A/bounds, per-seed
+    feasible b and objective c (same generator as the CLI self-check)."""
+    r = np.random.default_rng(seed)
+    x0 = r.uniform(0.5, 3.5, N)
+    c = r.standard_normal(N)
+    return LPData(
+        jnp.asarray(A), jnp.asarray(A @ x0), jnp.asarray(c),
+        jnp.zeros(N), jnp.full(N, 4.0), jnp.asarray(0.0),
+    )
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and bool(
+        np.all((a == b) | (np.isnan(a) & np.isnan(b)))
+    )
+
+
+def _assert_bitwise(ref, out):
+    for name, a, b in zip(ref._fields, ref, out):
+        assert _biteq(a, b), f"field {name} differs bitwise"
+
+
+def _reject_delta(before, after):
+    return sum(
+        after.get(k, 0.0) - before.get(k, 0.0)
+        for k in after if k.startswith("learned_warm_reject_total")
+    )
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """One small trained artifact over the module's LP family, plus the
+    cold reference solves the adversarial tests compare against."""
+    tmp = tmp_path_factory.mktemp("warmstart")
+    writer = DatasetWriter(str(tmp / "dataset"), varying=("b", "c"))
+    for s in range(48):
+        p = _problem(s)
+        sol = solve_lp(p)
+        assert bool(np.all(np.asarray(sol.converged))), s
+        writer.add(p, sol, iterations=int(np.asarray(sol.iterations)))
+    writer.close()
+    ds = load_dataset([str(tmp / "dataset")], varying=("b", "c"))
+    model, metrics = train_warmstart_model(
+        ds, hidden=(32, 32), epochs=300, seed=0,
+    )
+    path = model.save(str(tmp / "warm.npz"))
+    return {"path": path, "model": model, "dataset": ds, "metrics": metrics}
+
+
+def test_family_fingerprint_semantics():
+    # same structure + varying b/c -> one family across instances
+    fam = family_fingerprint(_problem(0), ("b", "c"))
+    assert family_fingerprint(_problem(99), ("b", "c")) == fam
+    # a different constraint matrix is a different family
+    other_A = np.random.default_rng(8).standard_normal((M, N))
+    assert family_fingerprint(_problem(0, other_A), ("b", "c")) != fam
+    # the varying declaration is part of the identity
+    assert family_fingerprint(_problem(0), ("b",)) != fam
+    # features are exactly the flattened varying fields
+    p = _problem(3)
+    np.testing.assert_array_equal(
+        features_of(p, ("b", "c")),
+        np.concatenate([np.asarray(p.b), np.asarray(p.c)]),
+    )
+
+
+def test_dataset_writer_pins_family_and_loader_roundtrips(tmp_path):
+    writer = DatasetWriter(str(tmp_path), varying=("b", "c"), shard_rows=4)
+    for s in range(6):
+        p = _problem(s)
+        sol = solve_lp(p)
+        assert writer.add(p, sol, iterations=int(np.asarray(sol.iterations)))
+    # an off-family row (different A) is dropped, not mixed in
+    alien = _problem(0, np.random.default_rng(9).standard_normal((M, N)))
+    assert not writer.add(alien, solve_lp(alien))
+    writer.close()
+    assert writer.skipped == 1
+
+    ds = load_dataset([str(tmp_path)], varying=("b", "c"))
+    assert len(ds) == 6
+    assert ds.family == family_fingerprint(_problem(0), ("b", "c"))
+    assert ds.problem_type == "LPData"
+    assert ds.targets == [("x", N), ("y", M), ("zl", N), ("zu", N)]
+    assert np.all(np.isfinite(ds.iters))
+    train, hold = ds.split(holdout_frac=0.25, seed=1)
+    assert len(train) + len(hold) == 6 and len(hold) >= 1
+
+
+def test_artifact_roundtrip_bitwise_and_refuse_to_load(artifact, tmp_path):
+    model, path = artifact["model"], artifact["path"]
+    loaded = WarmStartModel.load(path)
+    X = artifact["dataset"].X[:5]
+    assert np.array_equal(model.predict(X), loaded.predict(X)), (
+        "artifact round trip is not bitwise"
+    )
+    assert loaded.manifest == model.manifest
+
+    # wrong expected family refuses loudly
+    with pytest.raises(ArtifactMismatch):
+        WarmStartModel.load(path, expect_family="0" * 64)
+    # unknown version refuses
+    with np.load(path, allow_pickle=False) as dat:
+        payload = {k: dat[k] for k in dat.files}
+    manifest = json.loads(str(payload["__manifest__"]))
+    manifest["version"] = 99
+    payload["__manifest__"] = np.asarray(json.dumps(manifest))
+    bad = str(tmp_path / "bad-version.npz")
+    np.savez(bad, **payload)
+    with pytest.raises(ArtifactMismatch):
+        WarmStartModel.load(bad)
+    # an arbitrary npz is not an artifact
+    notart = str(tmp_path / "not-artifact.npz")
+    np.savez(notart, foo=np.zeros(3))
+    with pytest.raises(ArtifactMismatch):
+        WarmStartModel.load(notart)
+    # predictor construction forwards the family check
+    with pytest.raises(ArtifactMismatch):
+        WarmStartPredictor(path, expect_family="0" * 64)
+
+
+def _rigged(base, predict_parts):
+    """Copy of a trained model with its inference replaced — the manifest
+    still matches the family, so only the output safeguards can save us."""
+    clone = WarmStartModel(base.surrogate, base.manifest)
+    clone.predict_parts = predict_parts
+    return clone
+
+
+def test_adversarial_predictions_land_bitwise_cold(artifact):
+    """NaN, huge, wrong-shape, and raising predictors: every lane must be
+    rejected and the solve must be bitwise the cold solve."""
+    base = artifact["model"]
+    rows = [_problem(5000 + s) for s in range(3)]
+    cold = [solve_lp(p) for p in rows]
+    layout = [(n, d) for n, d in base.targets]
+
+    def _const(val):
+        def f(X):
+            return {n: np.full((X.shape[0], d), val) for n, d in layout}
+        return f
+
+    def _wrong_shape(X):
+        return {n: np.zeros((X.shape[0], d + 3)) for n, d in layout}
+
+    def _raises(X):
+        raise RuntimeError("synthetically poisoned artifact")
+
+    adversaries = {
+        "nan": _const(np.nan),
+        "huge": _const(1e12),
+        "wrong-shape": _wrong_shape,
+        "raises": _raises,
+    }
+    for name, rig in adversaries.items():
+        pred = WarmStartPredictor(_rigged(base, rig))
+        before = obs_metrics.flat_values()
+        seeds, accepted = pred.seed_rows(rows, entry="test_learn")
+        after = obs_metrics.flat_values()
+        assert accepted == [False] * len(rows), name
+        assert _reject_delta(before, after) == len(rows), name
+        for p, c, s in zip(rows, cold, seeds):
+            # every seed is well-shaped (the engine buffers it without
+            # crashing) and the solver rejects it wholesale
+            assert tuple(a.shape for a in s) == ((N,), (M,), (N,), (N,)), name
+            warm = solve_lp(p, warm_start=tuple(jnp.asarray(a) for a in s))
+            _assert_bitwise(c, warm)
+
+
+def test_good_predictions_accept_and_stay_healthy(artifact):
+    """In-family predictions pass the safeguard, converge, and cost no
+    more iterations than cold; off-family rows are rejected per lane."""
+    pred = WarmStartPredictor(artifact["path"])
+    rows = [_problem(6000 + s) for s in range(4)]
+    before = obs_metrics.flat_values()
+    seeds, accepted = pred.seed_rows(rows, entry="test_learn")
+    after = obs_metrics.flat_values()
+    assert sum(accepted) > 0, "trained predictor never passed its own family"
+    n_acc = sum(
+        after.get(k, 0.0) - before.get(k, 0.0)
+        for k in after if k.startswith("learned_warm_accept_total")
+    )
+    assert n_acc == sum(accepted)
+    for p, s, ok in zip(rows, seeds, accepted):
+        cold = solve_lp(p)
+        warm = solve_lp(p, warm_start=tuple(jnp.asarray(a) for a in s))
+        assert bool(np.asarray(warm.converged))
+        if ok:
+            # no per-lane iteration claim: savings are statistical and
+            # gated in aggregate by tools/train_warmstart.py --self-check;
+            # the per-lane contract is that an accepted seed still reaches
+            # the same optimum
+            np.testing.assert_allclose(
+                np.asarray(warm.x), np.asarray(cold.x), atol=1e-6, rtol=0,
+            )
+        else:
+            _assert_bitwise(cold, warm)
+
+    # a structurally different problem never gets a live seed
+    alien_A = np.random.default_rng(11).standard_normal((M, N))
+    a_seeds, a_acc = pred.seed_rows([_problem(0, alien_A)])
+    assert a_acc == [False]
+    assert all(np.all(np.isnan(a)) for a in a_seeds[0])
+
+
+def test_predictor_disabled_is_bitwise_cold(artifact):
+    """`warm_predictor=None` (the default) must reproduce the historical
+    cold path bitwise — both at the adaptive entry and through the
+    service; and a warm service whose predictor rejects everything must
+    also answer bitwise-cold."""
+    from dispatches_tpu.runtime.adaptive import solve_lp_adaptive
+    from dispatches_tpu.serve.service import make_dense_service
+    from dispatches_tpu.solvers.ipm import solve_lp_batch
+
+    B = 4
+    lps = [_problem(7000 + s) for s in range(B)]
+    lp = LPData(*(jnp.stack([p[i] for p in lps]) for i in range(6)))
+    ref = solve_lp_batch(lp, max_iter=60)
+    out = solve_lp_adaptive(
+        lp, chunk_iters=4, ladder_base=B, warm_predictor=None, max_iter=60,
+    )
+    _assert_bitwise(ref, out)
+
+    def _drain(svc, tickets, pumps=10000):
+        for _ in range(pumps):
+            svc.pump()
+            if all(t.done() for t in tickets):
+                return [t.result(timeout=0) for t in tickets]
+        raise RuntimeError("service did not drain")
+
+    # service lanes solve in a bucket of 4, so the single-lane solve_lp
+    # is NOT the bitwise reference on CPU (batched LAPACK rounding varies
+    # with batch count — see tests/test_zz_adaptive.py). The contract is
+    # determinism: two predictor-less services agree bitwise.
+    svc_off = make_dense_service(B, cache_size=None, max_iter=60)
+    res_off = _drain(svc_off, [svc_off.submit(p) for p in lps])
+    svc_off2 = make_dense_service(B, cache_size=None, max_iter=60)
+    res_off2 = _drain(svc_off2, [svc_off2.submit(p) for p in lps])
+    for r, r2 in zip(res_off, res_off2):
+        assert r.verdict == "healthy"
+        _assert_bitwise(r.solution, r2.solution)
+
+    nan_pred = WarmStartPredictor(_rigged(
+        artifact["model"],
+        lambda X: {
+            n: np.full((X.shape[0], d), np.nan)
+            for n, d in artifact["model"].targets
+        },
+    ))
+    svc_adv = make_dense_service(
+        B, cache_size=None, warm_model=nan_pred, max_iter=60,
+    )
+    res_adv = _drain(svc_adv, [svc_adv.submit(p) for p in lps])
+    for r, c in zip(res_adv, res_off):
+        assert r.verdict == "healthy"
+        _assert_bitwise(c.solution, r.solution)

@@ -61,6 +61,7 @@ _HIGHER_IS_BETTER = (
     "per_sec", "per_chip", "converged", "mfu", "tflops", "utilization",
     "throughput", 'verdict="healthy"', "iters_saved", "cache_hit",
     "lanes_retired", "goodput", "terminal/complete", "telemetry_frames",
+    "learned_warm_accept",
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
@@ -78,6 +79,13 @@ _ZERO_SEEDED = (
     "solve_verdict_total", "journey/terminal/", "burn_rate",
     "shard_respawn_total", "requeued_lanes_total", "serve_tenant_shed_total",
     "shard_telemetry_errors_total",
+    # learned warm starts (learn/): rejects only exist once the predictor
+    # degrades, so a clean baseline has no such series — seeding makes a
+    # safeguard-rejection storm appearing in NEW a gated regression.
+    # Accepts zero-seed too, but as higher-is-better they only gate on a
+    # same-workload DROP (predictor wedged / artifact refused), never on
+    # a predictor-enabled run appearing against a cold baseline.
+    "learned_warm_accept_total", "learned_warm_reject_total",
 )
 
 
@@ -486,7 +494,8 @@ def self_check(out=sys.stdout) -> int:
     # gate protects), iterations-saved and cache hits higher-is-better
     abase = {
         'metric/ipm_iterations_total{runner="yearsweep"}': 400.0,
-        'metric/warm_start_iters_saved_total{runner="yearsweep"}': 80.0,
+        'metric/warm_start_iters_saved_total'
+        '{runner="yearsweep",source="neighbor"}': 80.0,
         'metric/compile_cache_hit_total{entry="solve_lp_banded"}': 12.0,
     }
 
@@ -503,11 +512,13 @@ def self_check(out=sys.stdout) -> int:
           'metric/ipm_iterations_total{runner="yearsweep"}': 320.0}, False)
     arun("warm-start savings dropping >10% fails (higher is better)",
          {**abase,
-          'metric/warm_start_iters_saved_total{runner="yearsweep"}': 40.0},
+          'metric/warm_start_iters_saved_total'
+          '{runner="yearsweep",source="neighbor"}': 40.0},
          True)
     arun("warm-start savings growing passes",
          {**abase,
-          'metric/warm_start_iters_saved_total{runner="yearsweep"}': 120.0},
+          'metric/warm_start_iters_saved_total'
+          '{runner="yearsweep",source="neighbor"}': 120.0},
          False)
     arun("compile-cache hits dropping >10% fails",
          {**abase,
@@ -652,6 +663,63 @@ def self_check(out=sys.stdout) -> int:
         {k: v for k, v in tbase.items() if "telemetry" not in k}, tbase,
     )
     checks.append(("telemetry-on run vs telemetry-off baseline passes",
+                   False, any(r["regression"] for r in rows)))
+
+    # learned warm starts (learn/ + tools/train_warmstart.py): accepts
+    # and iterations saved are higher-is-better, safeguard rejects gate
+    # lower-is-better and appearing-from-zero; the learned and neighbor
+    # sources are separate label series, so a learned regression cannot
+    # hide behind healthy neighbor savings
+    wbase = {
+        'metric/learned_warm_accept_total'
+        '{entry="serve_dense",source="learned"}': 90.0,
+        'metric/learned_warm_reject_total'
+        '{entry="serve_dense",source="learned"}': 10.0,
+        'metric/warm_start_iters_saved_total'
+        '{entry="serve_dense",source="learned"}': 300.0,
+        'metric/warm_start_iters_saved_total'
+        '{runner="yearsweep",source="neighbor"}': 80.0,
+    }
+
+    def wrun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(wbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    wrun("identical learned-warm counters pass", dict(wbase), False)
+    wrun("accepted seeds dropping >10% fails (predictor wedged)",
+         {**wbase,
+          'metric/learned_warm_accept_total'
+          '{entry="serve_dense",source="learned"}': 40.0}, True)
+    wrun("safeguard rejects tripling fails (lower is better)",
+         {**wbase,
+          'metric/learned_warm_reject_total'
+          '{entry="serve_dense",source="learned"}': 30.0}, True)
+    wrun("learned savings dropping >10% fails even with neighbor steady",
+         {**wbase,
+          'metric/warm_start_iters_saved_total'
+          '{entry="serve_dense",source="learned"}': 100.0}, True)
+    coldbase = {
+        k: v for k, v in wbase.items()
+        if "learned" not in k
+    }
+    rows = compare(coldbase, wbase)
+    checks.append((
+        "predictor-enabled run vs cold baseline: rejects appearing "
+        "from zero fail (zero-seeded)",
+        True, any(r["regression"] for r in rows)))
+    rows = compare(
+        coldbase,
+        {k: v for k, v in wbase.items() if "reject" not in k},
+    )
+    checks.append((
+        "predictor-enabled run with zero rejects vs cold baseline passes",
+        False, any(r["regression"] for r in rows)))
+    rows = compare(wbase, {
+        **wbase,
+        'metric/learned_warm_reject_total'
+        '{entry="serve_dense",source="learned"}': 10.5,
+    })
+    checks.append(("rejects within threshold pass",
                    False, any(r["regression"] for r in rows)))
 
     ok = True

@@ -136,6 +136,7 @@ def run_service(
     kill_shard: bool = False,
     telemetry: bool = False,
     exporter_port=None,
+    warm_model=None,
 ) -> dict:
     """Drive the service at `rate` req/s; returns the report dict.
     `reqtrace` records per-request journeys into the process tracer's
@@ -146,21 +147,30 @@ def run_service(
     through the submissions (chaos: respawn + requeue under load).
     `telemetry` (fleet only) ships shard-child registry deltas into the
     parent registry; `exporter_port` serves /metrics + /healthz + /slo
-    from this process for the duration of the run (0 = ephemeral)."""
+    from this process for the duration of the run (0 = ephemeral).
+    `warm_model` (tools/train_warmstart.py artifact path) seeds cold
+    dispatches through the solvers' safeguarded learned warm-start path;
+    the report then carries the accept/iters-saved counter deltas."""
     _enable_x64()
+    from dispatches_tpu.obs import metrics as obs_metrics
     from dispatches_tpu.serve import make_dense_fleet, make_dense_service
 
+    warm_before = (
+        obs_metrics.flat_values() if warm_model is not None else None
+    )
     if shards > 0:
         svc = make_dense_fleet(
             shards, bucket, chunk_iters=chunk_iters,
             queue_limit=queue_limit, reqtrace=reqtrace,
             telemetry=telemetry,
             solver_kw={"max_iter": max_iter},
+            warm_model=warm_model,
         )
     else:
         svc = make_dense_service(
             bucket, chunk_iters=chunk_iters, max_iter=max_iter,
             queue_limit=queue_limit, reqtrace=reqtrace,
+            warm_model=warm_model,
         )
     seeds = problem_seeds(requests, dup_frac, seed)
     problems = {s: make_problem(s, n=lp_n, m=lp_m) for s in set(seeds)}
@@ -251,6 +261,26 @@ def run_service(
                 ),
             }
             for k, v in (report["service"].get("per_shard") or {}).items()
+        }
+    if warm_before is not None:
+        # counter deltas over this run (fleet counters need --telemetry
+        # to fold child registries into this process before they show)
+        after = obs_metrics.flat_values()
+
+        def _delta(prefix, extra=""):
+            return sum(
+                after.get(k, 0.0) - warm_before.get(k, 0.0)
+                for k in after
+                if k.startswith(prefix) and extra in k
+            )
+
+        report["warm"] = {
+            "model": str(warm_model),
+            "accepted": _delta("learned_warm_accept_total"),
+            "rejected": _delta("learned_warm_reject_total"),
+            "iters_saved": _delta(
+                "warm_start_iters_saved_total", 'source="learned"'
+            ),
         }
     if exporter is not None:
         report["exporter_url"] = exporter.url()
@@ -740,9 +770,72 @@ def _check_journeys(journal, latencies, out) -> list:
     return failures
 
 
+def _warm_model_pass(out) -> list:
+    """Learned warm-start leg: train an artifact on the first half of a
+    synthetic request stream (cold solves journaled into a dataset),
+    serve the second half through ``warm_model=``, and require learned
+    iterations saved with zero lost/unhealthy. `make_problem` varies A
+    per seed, so the family features A alongside b and c."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from dispatches_tpu.learn import (
+        DatasetWriter, load_dataset, train_warmstart_model,
+    )
+    from dispatches_tpu.solvers.ipm import solve_lp
+
+    failures = []
+    tmp = tempfile.mkdtemp(prefix="loadgen-warm-")
+    try:
+        writer = DatasetWriter(
+            os.path.join(tmp, "dataset"), varying=("A", "b", "c"),
+        )
+        for s in range(9000, 9096):
+            p = make_problem(s)
+            sol = solve_lp(p)
+            writer.add(p, sol, iterations=int(np.asarray(sol.iterations)))
+        writer.close()
+        model, _ = train_warmstart_model(
+            load_dataset([os.path.join(tmp, "dataset")],
+                         varying=("A", "b", "c")),
+            hidden=(48, 48), epochs=400, seed=0,
+        )
+        path = model.save(os.path.join(tmp, "warm"))
+        report = run_service(
+            requests=48, rate=400.0, bucket=8, dup_frac=0.0, seed=9500,
+            warm_model=path,
+        )
+        warm = report.get("warm") or {}
+        print(
+            f"  warm-model pass: accepted={warm.get('accepted', 0):g} "
+            f"rejected={warm.get('rejected', 0):g} "
+            f"iters_saved={warm.get('iters_saved', 0):g}",
+            file=out,
+        )
+        if report["lost"]:
+            failures.append(
+                f"warm-model pass: {report['lost']} lost requests"
+            )
+        if report["unhealthy"]:
+            failures.append(
+                f"warm-model pass: {report['unhealthy']} unhealthy solves"
+            )
+        if not warm.get("iters_saved", 0.0) > 0.0:
+            failures.append(
+                "warm-model pass: warm_start_iters_saved_total"
+                '{source="learned"} did not increase'
+            )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return failures
+
+
 def self_check(out=sys.stdout) -> int:
     """CI smoke: ~200 requests on CPU with journey tracing, zero lost,
-    p95 + journey completeness + timeline export + SLO burn gated."""
+    p95 + journey completeness + timeline export + SLO burn gated,
+    plus a train-then-serve learned warm-start leg."""
     from dispatches_tpu.obs.journal import Tracer, read_journal, use_tracer
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -763,6 +856,7 @@ def self_check(out=sys.stdout) -> int:
         latencies = report.pop("latencies_by_id")
         latencies.update(_terminal_mini_pass(out))
         chaos_failures = _fleet_chaos_pass(out)
+        chaos_failures += _warm_model_pass(out)
         tr.event("loadgen_report", **{
             k: v for k, v in report.items() if isinstance(v, (int, float))
         })
@@ -846,6 +940,10 @@ def main(argv=None) -> int:
                     help="serve /metrics /healthz /slo /snapshot on this "
                     "port for the duration of the run (0 = ephemeral; "
                     "implies --telemetry when --shards > 0)")
+    ap.add_argument("--warm-model", default=None,
+                    help="learned warm-start artifact "
+                    "(tools/train_warmstart.py) seeding cold dispatches; "
+                    "the report gains accept/iters-saved deltas")
     ap.add_argument("--baseline", choices=["serial"], default=None,
                     help="run the one-at-a-time baseline instead")
     ap.add_argument("--json", action="store_true",
@@ -893,6 +991,7 @@ def main(argv=None) -> int:
                     args.shards > 0 and args.exporter_port is not None
                 ),
                 exporter_port=args.exporter_port,
+                warm_model=args.warm_model,
             )
         finally:
             if tracer is not None:

@@ -102,10 +102,22 @@ def replay(capture_path):
 
     if solver == "solve_lp":
         from dispatches_tpu.solvers.ipm import solve_lp as entry
+
+        warm_parts = ("x", "y", "zl", "zu")
     else:
         from dispatches_tpu.solvers.pdhg import solve_lp_pdhg as entry
+
+        warm_parts = ("x", "y")
     opts = _filtered_options(entry, meta.get("options"))
-    sol = entry(problem, **opts)
+    # captured warm seeds (learned or neighbor) re-feed the solver RAW:
+    # the safeguard clip/reject re-applies deterministically, so a
+    # warm-started failure must reproduce bitwise too. `applied_*` /
+    # `accepted` keys are the post-safeguard view, for reading not replay.
+    warm = cap.get("warm_start") or {}
+    warm_start = None
+    if all(p in warm for p in warm_parts):
+        warm_start = tuple(warm[p] for p in warm_parts)
+    sol = entry(problem, warm_start=warm_start, **opts)
 
     recorded = cap["solution"]
     report = {
@@ -113,6 +125,7 @@ def replay(capture_path):
         "solver": solver,
         "options": opts,
         "verdict_at_capture": meta.get("verdict"),
+        "warm_start": sorted(warm) if warm else None,
         "fields": {},
     }
     bitwise = True
@@ -186,9 +199,29 @@ def self_check():
         arch = rec2.capture("solve_nlp", arrays={"x0": np.zeros(3)})
         rc2, _ = replay(arch)
         assert rc2 == RC_NOT_REPLAYABLE, rc2
+        # a warm-started failure (learned-predictor path) must also
+        # reproduce bitwise: the capture carries the raw seed and the
+        # replay re-feeds it through the solver's own safeguard
+        from dispatches_tpu.obs.recorder import warm_bundle
+
+        n = lp.c.shape[0]
+        seed = (
+            np.full(n, 0.5), np.zeros(lp.b.shape[0]),
+            np.full(n, 0.1), np.full(n, 0.1),
+        )
+        sol_w = solve_lp(lp, warm_start=seed, **options)
+        cap_w = rec2.capture(
+            "solve_lp", problem=lp, options=options,
+            verdict=classify_trace(tr, sol=sol_w)[0],
+            warm_start=warm_bundle(lp, seed), solution=sol_w,
+        )
+        rc3, rep_w = replay(cap_w)
+        assert rep_w["warm_start"], "warm seed missing from capture"
+        assert rc3 == RC_OK, f"warm replay not bitwise (rc={rc3})"
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
-    print("self-check: OK (capture -> replay reproduced bitwise)")
+    print("self-check: OK (capture -> replay reproduced bitwise, "
+          "warm-started capture included)")
     return RC_OK
 
 

@@ -39,6 +39,11 @@ and per-tenant fairness — requests may carry a ``tenant`` id) instead of
 the in-process engine. Unknown ops and malformed lines produce an
 ``{"error": ...}`` response instead of killing the loop.
 
+``--warm-model warm.npz`` seeds freshly admitted lanes from a learned
+warm-start artifact (tools/train_warmstart.py) through the solver's
+clip + per-lane rejection safeguard — a bad prediction degrades to the
+cold path, never to a wrong answer (docs/learned_warmstarts.md).
+
 ``--exporter-port P`` serves the fleet telemetry plane over HTTP for
 the lifetime of the loop: ``/metrics`` (Prometheus), ``/healthz``
 (per-shard liveness, non-200 while any shard is down), ``/slo`` (burn
@@ -164,6 +169,10 @@ def main(argv=None, out=sys.stdout) -> int:
                     help="serve /metrics /healthz /slo /snapshot on this "
                     "port (0 = ephemeral, printed to stderr; implies "
                     "--telemetry when --shards > 0)")
+    ap.add_argument("--warm-model", default=None,
+                    help="learned warm-start artifact (.npz from "
+                    "tools/train_warmstart.py); seeds fresh lanes through "
+                    "the solver safeguard — docs/learned_warmstarts.md")
     args = ap.parse_args(argv)
 
     import jax
@@ -220,6 +229,7 @@ def main(argv=None, out=sys.stdout) -> int:
                                 telemetry=args.telemetry or (
                                     args.exporter_port is not None
                                 ),
+                                warm_model=args.warm_model,
                                 solver_kw={"max_iter": args.max_iter},
                             )
                         else:
@@ -229,6 +239,7 @@ def main(argv=None, out=sys.stdout) -> int:
                                 queue_limit=args.queue_limit,
                                 cache_size=args.cache_size or None,
                                 reqtrace=args.reqtrace,
+                                warm_model=args.warm_model,
                             )
                         svc.start()
                     kw = {}

@@ -238,6 +238,12 @@ def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
     # runners attach these as solve-event attrs
     if ev.get("warm_starts"):
         line += " warm"
+    # learned warm-start attribution (learn/predictor.py via the serve
+    # tier): source + the safeguard's accept/reject verdict. Journals
+    # predating the field render exactly as before.
+    if ev.get("warm_source"):
+        verdict = "accept" if ev.get("warm_accepted") else "reject"
+        line += f" warm={ev['warm_source']}/{verdict}"
     ad = ev.get("adaptive_stats")
     if isinstance(ad, dict):
         line += (
@@ -336,6 +342,26 @@ def _print_health_footer(run: List[dict], out) -> None:
         if w.get("quantity"):
             bits.append(str(w["quantity"]))
         print(f"  worst offender: {where} ({', '.join(bits)})", file=out)
+
+
+def _print_warm_footer(run: List[dict], out) -> None:
+    """Run-level learned warm-start aggregate: per-source solve counts
+    and safeguard accept rate. Silent when no solve record carried a
+    ``warm_source`` (pre-warm-start journals render exactly as before)."""
+    per_src: dict = {}
+    for ev in run:
+        if ev.get("kind") == "solve" and ev.get("warm_source"):
+            n, acc = per_src.get(ev["warm_source"], (0, 0))
+            per_src[ev["warm_source"]] = (
+                n + 1, acc + (1 if ev.get("warm_accepted") else 0)
+            )
+    if not per_src:
+        return
+    txt = ", ".join(
+        f"{src}: {acc}/{n} accepted ({acc / n:.0%})"
+        for src, (n, acc) in sorted(per_src.items())
+    )
+    print(f"  warm starts: {txt}", file=out)
 
 
 def _print_journeys_footer(run: List[dict], out) -> None:
@@ -439,6 +465,7 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
     _print_spans(run, out, max_spans)
     _print_solves(run, out)
     _print_health_footer(run, out)
+    _print_warm_footer(run, out)
     _print_journeys_footer(run, out)
     close = next((e for e in run if e.get("kind") == "close"), None)
     if close is not None:

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Train a learned warm-start artifact from journaled solves.
+
+    python tools/train_warmstart.py RUN.jsonl -o warm.npz
+    python tools/train_warmstart.py SHARD_DIR CAPTURE_DIR -o warm.npz
+    python tools/train_warmstart.py --self-check            # CI smoke
+
+Sources are any mix of JSONL journals (followed to the `dataset_shard` /
+`capture` paths they mention), `learn.DatasetWriter` shard directories,
+and flight-recorder capture dirs. Rows outside the first source's LP
+family (structural `family_fingerprint`) are skipped, not mixed in; the
+artifact refuses to load against a different family at serve time.
+
+The artifact (`learn.WarmStartModel` .npz) carries weights + feature
+scaling + the family manifest + the measured cold-iteration baseline
+used for ``warm_start_iters_saved_total{source="learned"}`` attribution.
+Serve it with ``make_dense_service(..., warm_model=PATH)``,
+``make_dense_fleet(..., warm_model=PATH)``, ``loadgen --warm-model``, or
+``solve_lp_adaptive(..., warm_predictor=PATH)``.
+
+``--self-check`` runs the whole loop synthetically: journal a cold solve
+sweep, train on the journal, serve a fresh request stream through the
+safeguarded warm path, and require iterations saved with zero
+lost/unhealthy requests — plus family-mismatch refusal and cold-path
+determinism with the predictor off.
+
+Exit codes: 0 = ok, 1 = self-check gate failed, 2 = error.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+RC_OK, RC_GATE, RC_ERROR = 0, 1, 2
+
+
+def _enable_x64():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+def train(sources, out, *, varying, family=None, healthy_only=True,
+          hidden=(64, 64), epochs=300, lr=1e-3, seed=0, holdout_frac=0.2,
+          verbose=False):
+    """Load pairs, train one per-family predictor, save the artifact.
+    Returns the report dict (also journaled as `warmstart_artifact`)."""
+    from dispatches_tpu.learn import load_dataset, train_warmstart_model
+    from dispatches_tpu.obs.journal import get_tracer
+
+    ds = load_dataset(
+        sources, varying=varying, family=family, healthy_only=healthy_only,
+    )
+    model, metrics = train_warmstart_model(
+        ds, hidden=hidden, epochs=epochs, lr=lr, seed=seed,
+        holdout_frac=holdout_frac, verbose=verbose,
+    )
+    path = model.save(out)
+    report = {
+        "artifact": path,
+        "family": ds.family,
+        "problem_type": ds.problem_type,
+        "varying": list(ds.varying),
+        "rows": int(len(ds)),
+        "rows_skipped": int(ds.skipped),
+        "feature_dim": int(ds.X.shape[1]),
+        "target_dim": int(ds.Y.shape[1]),
+        "metrics": metrics,
+    }
+    get_tracer().event("warmstart_artifact", path=path, family=ds.family,
+                       rows=int(len(ds)), metrics=metrics)
+    return report
+
+
+def _drain(service, tickets, pumps=10000):
+    for _ in range(pumps):
+        service.pump()
+        if all(t.done() for t in tickets):
+            return [t.result(timeout=0) for t in tickets]
+    raise RuntimeError("service did not drain (lost requests)")
+
+
+def self_check(keep=None):
+    """Journal -> train -> serve round trip on a synthetic LP family."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    _enable_x64()
+
+    from dispatches_tpu.core.program import LPData
+    from dispatches_tpu.learn import (
+        ArtifactMismatch, DatasetWriter, WarmStartModel,
+    )
+    from dispatches_tpu.obs import metrics as obs_metrics
+    from dispatches_tpu.obs.journal import Tracer, use_tracer
+    from dispatches_tpu.serve.service import make_dense_service
+    from dispatches_tpu.solvers.ipm import solve_lp
+
+    rng = np.random.default_rng(7)
+    n, m = 8, 4
+    A = rng.standard_normal((m, n))
+
+    def make_problem(seed):
+        r = np.random.default_rng(seed)
+        x0 = r.uniform(0.5, 3.5, n)
+        c = r.standard_normal(n)
+        return LPData(A, A @ x0, c, np.zeros(n), np.full(n, 4.0), 0.0)
+
+    tmp = keep or tempfile.mkdtemp(prefix="warmstart-selfcheck-")
+    try:
+        # -- first half: journaled cold sweep feeding the dataset ------
+        journal = os.path.join(tmp, "run.jsonl")
+        with use_tracer(Tracer(journal)):
+            writer = DatasetWriter(
+                os.path.join(tmp, "dataset"), varying=("b", "c"),
+            )
+            for s in range(64):
+                p = make_problem(s)
+                sol = solve_lp(p)
+                assert bool(np.all(np.asarray(sol.converged))), s
+                writer.add(p, sol, iterations=int(np.asarray(sol.iterations)))
+            writer.close()
+            # train FROM THE JOURNAL: the artifact path every production
+            # run has (journal -> dataset_shard events -> shards)
+            report = train(
+                [journal], os.path.join(tmp, "warm.npz"),
+                varying=("b", "c"), hidden=(32, 32), epochs=400, seed=0,
+            )
+        print("self-check: trained", json.dumps(report["metrics"]))
+        assert report["rows"] == 64, report
+
+        # -- refuse-to-load on a family mismatch -----------------------
+        try:
+            WarmStartModel.load(report["artifact"], expect_family="0" * 64)
+        except ArtifactMismatch:
+            pass
+        else:
+            raise AssertionError("family mismatch did not refuse to load")
+
+        # -- second half: serve a fresh stream through the warm path ---
+        reqs = [make_problem(1000 + s) for s in range(24)]
+        before = obs_metrics.flat_values()
+        svc = make_dense_service(
+            4, cache_size=None, warm_model=report["artifact"], max_iter=60,
+        )
+        warm_res = _drain(svc, [svc.submit(p) for p in reqs])
+        after = obs_metrics.flat_values()
+
+        bad = [r.verdict for r in warm_res if r.verdict != "healthy"]
+        if bad:
+            print(f"self-check: GATE unhealthy verdicts {bad}",
+                  file=sys.stderr)
+            return RC_GATE
+        saved = sum(
+            after.get(k, 0.0) - before.get(k, 0.0)
+            for k in after
+            if k.startswith("warm_start_iters_saved_total")
+            and 'source="learned"' in k
+        )
+        accepted = sum(
+            after.get(k, 0.0) - before.get(k, 0.0)
+            for k in after
+            if k.startswith("learned_warm_accept_total")
+        )
+        print(f"self-check: served {len(warm_res)} warm "
+              f"(accepted={accepted:g}, iters_saved={saved:g})")
+        if not saved > 0:
+            print("self-check: GATE warm_start_iters_saved_total"
+                  '{source="learned"} did not increase', file=sys.stderr)
+            return RC_GATE
+
+        # -- predictor off: the historical cold path, deterministic ----
+        svc_a = make_dense_service(4, cache_size=None, max_iter=60)
+        cold_a = _drain(svc_a, [svc_a.submit(p) for p in reqs])
+        svc_b = make_dense_service(4, cache_size=None, max_iter=60)
+        cold_b = _drain(svc_b, [svc_b.submit(p) for p in reqs])
+        for ra, rb in zip(cold_a, cold_b):
+            xa, xb = np.asarray(ra.solution.x), np.asarray(rb.solution.x)
+            if not (xa.dtype == xb.dtype and np.array_equal(xa, xb)):
+                print("self-check: GATE cold path not deterministic",
+                      file=sys.stderr)
+                return RC_GATE
+        # warm answers must agree with cold answers to solver tolerance
+        worst = max(
+            float(np.max(np.abs(np.asarray(w.solution.x)
+                                - np.asarray(c.solution.x))))
+            for w, c in zip(warm_res, cold_a)
+        )
+        print(f"self-check: warm-vs-cold max |dx| = {worst:.2e}")
+        if worst > 1e-6:
+            print("self-check: GATE warm answers diverged from cold",
+                  file=sys.stderr)
+            return RC_GATE
+    finally:
+        if not keep:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("self-check: OK (journal -> train -> safeguarded warm serving)")
+    return RC_OK
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("sources", nargs="*",
+                    help="journals (.jsonl), DatasetWriter shard dirs, "
+                         "and/or recorder capture dirs")
+    ap.add_argument("-o", "--out", help="artifact output path (.npz)")
+    ap.add_argument("--varying", default="b,c",
+                    help="comma-separated per-instance fields -> features "
+                         "(default: b,c)")
+    ap.add_argument("--family", default=None,
+                    help="expected family fingerprint (hex); rows outside "
+                         "it are skipped, an empty result errors")
+    ap.add_argument("--include-unhealthy", action="store_true",
+                    help="keep non-converged pairs (default: healthy only)")
+    ap.add_argument("--hidden", default="64,64",
+                    help="MLP hidden widths (default: 64,64)")
+    ap.add_argument("--epochs", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--holdout-frac", type=float, default=0.2)
+    ap.add_argument("--x64", type=int, default=1,
+                    help="enable float64 before training (default 1; match "
+                         "the precision the artifact will serve under)")
+    ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as JSON only")
+    ap.add_argument("--self-check", action="store_true",
+                    help="synthetic journal->train->serve round trip")
+    ap.add_argument("--keep", default=None,
+                    help="with --self-check: keep scratch under this dir")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check(keep=args.keep)
+    if not args.sources or not args.out:
+        ap.error("sources and -o/--out required (or --self-check)")
+    if args.x64:
+        _enable_x64()
+    try:
+        hidden = tuple(int(h) for h in args.hidden.split(",") if h)
+        varying = tuple(v for v in args.varying.split(",") if v)
+        report = train(
+            args.sources, args.out,
+            varying=varying, family=args.family,
+            healthy_only=not args.include_unhealthy,
+            hidden=hidden, epochs=args.epochs, lr=args.lr, seed=args.seed,
+            holdout_frac=args.holdout_frac, verbose=args.verbose,
+        )
+    except Exception as exc:  # noqa: BLE001 - CLI boundary
+        print(f"train_warmstart: error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return RC_ERROR
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        mt = report["metrics"]
+        print(f"train_warmstart: {report['artifact']}")
+        print(f"  family {report['family'][:16]}... "
+              f"({report['problem_type']}, varying={report['varying']})")
+        print(f"  rows {report['rows']} (+{report['rows_skipped']} skipped) "
+              f"features {report['feature_dim']} -> targets "
+              f"{report['target_dim']}")
+        print("  " + " ".join(
+            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in mt.items() if v is not None
+        ))
+    return RC_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())
