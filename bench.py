@@ -1404,6 +1404,46 @@ def main():
         "row", row="serve_warmstart", **_LOCAL["rows"]["serve_warmstart"]
     )
 
+    # Self-healing row (runtime/remedy.py): a forced-divergence micro-case
+    # — a rank-deficient equality block solved with zero KKT
+    # regularization, which reliably stalls the IPM — must come back
+    # healthy through the escalation ladder (the regularize rung cures
+    # it; the cold retry, same options, fails the same way first). Rides
+    # the serve block for loadgen's x64 convention.
+    def _remedy_row():
+        from dispatches_tpu.core.program import LPData
+        from dispatches_tpu.obs import health as _rh
+        from dispatches_tpu.runtime.remedy import REMEDIABLE, RemedyEngine
+        from dispatches_tpu.solvers.ipm import solve_lp as _slp
+
+        lp = LPData(
+            np.array([[1.0, 1.0], [1.0, 1.0]]), np.array([1.0, 1.0]),
+            np.array([1.0, 2.0]), np.zeros(2), np.full(2, 10.0), 0.0,
+        )
+        kw = dict(tol=1e-8, max_iter=60, reg_p=0.0, reg_d=0.0)
+        sick = _slp(lp, **kw)
+        v = _rh.classify_solution(sick, budget=60)[0]
+        eng = RemedyEngine(solver_kw=kw, entry="bench")
+        t0 = time.perf_counter()
+        outcome = eng.remediate(lp, v)
+        wall = time.perf_counter() - t0
+        return {
+            "original_verdict": v.verdict,
+            "forced_unhealthy": v.verdict in REMEDIABLE,
+            "recovered": outcome.recovered,
+            "rung": outcome.rung,
+            "attempts": outcome.attempts,
+            "ladder_wall_s": round(wall, 4),
+            "gate_ok": v.verdict in REMEDIABLE and outcome.recovered,
+        }
+
+    rm = _device("remediation ladder", _remedy_row)
+    _LOCAL["rows"]["remediation"] = rm
+    _DIAG.setdefault("serve", {})["remediation"] = dict(rm)
+    _atomic_dump(_DIAG, _DIAG_PATH)
+    _flush_local()
+    _journal().event("row", row="remediation", **rm)
+
     result = {
         "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
         f"(T=168h, batch={B}, converged={conv_frac:.3f}, "

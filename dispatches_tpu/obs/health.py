@@ -60,11 +60,15 @@ CYCLE_AMP = 0.10  # cycling: minimum relative amplitude (flat != cycling)
 # deadline_exceeded/shed are SERVICE verdicts (dispatches_tpu.serve):
 # the solve itself may be fine but the answer was late (best-iterate
 # returned) or never attempted (load shed) — worse than any converged-
-# but-ugly trajectory, better than a solver breakdown.
+# but-ugly trajectory, better than a solver breakdown. `poisoned` is the
+# fleet's quarantine verdict (a request whose dispatches keep killing
+# shards, serve/fleet.py) and `unrecoverable` is the remediation
+# ladder's give-up verdict (runtime/remedy.py): both mean the system
+# *decided* to stop trying, which outranks any single bad trajectory.
 SEVERITY = (
     "healthy", "slow", "cycling", "stalled",
-    "deadline_exceeded", "shed", "shed_tenant_quota",
-    "diverged", "nonfinite", "hang", "failed",
+    "deadline_exceeded", "shed", "shed_tenant_quota", "poisoned",
+    "diverged", "nonfinite", "unrecoverable", "hang", "failed",
 )
 
 # trajectory fields in blame-precedence order: residuals first (what the

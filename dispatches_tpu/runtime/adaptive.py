@@ -288,6 +288,75 @@ def _adaptive_drive(
     return out, tr_out
 
 
+def _apply_remedy(
+    remedy, fields_cls, data, axes, batch, out, tr, budget,
+    *, meta=None, stats=None,
+):
+    """Post-drive remediation hook shared by the adaptive entry points:
+    classify every lane of the stacked result (trace-aware when traces
+    were collected — cycling/divergence onset is invisible to end-state
+    classification) and run `runtime.remedy`'s escalation ladder for the
+    remediable ones, substituting recovered rows in place. Lanes that
+    stay unhealthy keep their original rows (the ladder's `unrecoverable`
+    verdict rides in ``stats["remediated"]`` and the journal). Traces are
+    NOT rewritten: a remediated lane's trace still shows the original
+    failing trajectory — that is the diagnostic record of *why* the
+    ladder ran. No-op (identical arrays returned) when every lane is
+    healthy."""
+    import jax.numpy as jnp
+
+    from ..obs import health as obs_health
+    from .remedy import REMEDIABLE
+
+    verdicts = None
+    if tr is not None:
+        try:
+            verdicts = obs_health.classify_trace(tr, sol=out)
+        except Exception:
+            verdicts = None
+    if verdicts is None:
+        verdicts = obs_health.classify_solution(out, budget=budget) or []
+    bad = [i for i, v in enumerate(verdicts) if v.verdict in REMEDIABLE]
+    if not bad:
+        return out, tr
+    data_np = [np.asarray(a) for a in data]
+    infos = {}
+    if batch is None:
+        outc = remedy.remediate(fields_cls(*data_np), verdicts[0], meta=meta)
+        infos[0] = _remedy_info(verdicts[0], outc)
+        if outc.recovered:
+            out = type(out)(*(jnp.asarray(np.asarray(a)) for a in outc.solution))
+    else:
+        sol_np = [np.array(leaf) for leaf in out]  # writable host copies
+        hit = False
+        for i in bad:
+            problem = fields_cls(*(
+                a[i] if ax == 0 else a for a, ax in zip(data_np, axes)
+            ))
+            outc = remedy.remediate(problem, verdicts[i], meta=meta)
+            infos[i] = _remedy_info(verdicts[i], outc)
+            if outc.recovered:
+                hit = True
+                for j, leaf in enumerate(outc.solution):
+                    sol_np[j][i] = np.asarray(leaf)
+        if hit:
+            out = type(out)(*(jnp.asarray(a) for a in sol_np))
+    if stats is not None:
+        stats["remediated"] = {int(k): v for k, v in infos.items()}
+    return out, tr
+
+
+def _remedy_info(verdict, outcome) -> dict:
+    """JSON-safe per-lane remediation record for stats/journals."""
+    return {
+        "original": verdict.verdict,
+        "verdict": outcome.verdict.verdict,
+        "rung": outcome.rung,
+        "attempts": outcome.attempts,
+        "recovered": outcome.recovered,
+    }
+
+
 def _tree_rows(leaf, rows):
     """Gather rows of one state leaf (numpy array, or a nested pytree leaf
     from a NamedTuple state — e.g. IPMState.trace is itself a SolveTrace)."""
@@ -503,6 +572,10 @@ class SlotEngine:
         # duck type: chunk_begin / cold_end / compute_end / harvest_end).
         # None keeps the hot path branch-free of tracing work.
         self.observer = None
+        # optional remediation engine (runtime.remedy.RemedyEngine): lanes
+        # that harvest unhealthy re-solve up the escalation ladder before
+        # the caller sees them. None keeps the harvest untouched.
+        self.remedy = None
 
     # -- slot management ----------------------------------------------
     def free_slots(self) -> int:
@@ -759,6 +832,14 @@ class SlotEngine:
                                 "warm_start_iters_saved_total", saved,
                                 source=src, entry=self.entry,
                             )
+                if self.remedy is not None:
+                    row, rinfo = self.remedy.remediate_solution_row(
+                        self._row_problem(i), row, budget=self.max_iter,
+                        deadline=getattr(token, "deadline", None),
+                        request_id=getattr(token, "request_id", None),
+                    )
+                    if rinfo is not None:
+                        lane_stats["remediation"] = rinfo
                 out.append((token, row, lane_stats))
                 self._release(i)
                 retired += 1
@@ -778,6 +859,7 @@ def make_dense_engine(
     chunk_iters: int = 8,
     trace: bool = False,
     warm_predictor=None,
+    remedy=None,
     **solver_kw,
 ) -> "SlotEngine":
     """One dense-LP `SlotEngine` at `bucket` lanes — the construction
@@ -791,7 +873,12 @@ def make_dense_engine(
     or an artifact path) seeds every admitted lane through the
     safeguarded warm-start path; with it None (the default) the engine —
     segments, compile keys, and solution bits — is exactly the
-    historical one."""
+    historical one.
+
+    `remedy` (a `runtime.remedy.RemedyEngine` / `RemedyPolicy` / True)
+    re-solves lanes that harvest unhealthy up the escalation ladder
+    before they reach the caller; None (the default) leaves the harvest
+    untouched."""
     from ..core.program import LPData
 
     solver_kw.setdefault("max_iter", 60)
@@ -817,11 +904,18 @@ def make_dense_engine(
     seg_cold, seg_resume = dense_segments(
         d_axes, w_ax, trace, solver_kw, stop_axis=0
     )
-    return SlotEngine(
+    engine = SlotEngine(
         "serve_dense", LPData, seg_cold, seg_resume, bucket,
         chunk_iters=chunk_iters, max_iter=solver_kw["max_iter"],
         trace=trace, opt_key=opt_key, warm_fn=warm_fn,
     )
+    if remedy is not None:
+        from .remedy import as_remedy
+
+        engine.remedy = as_remedy(
+            remedy, solver_kw=solver_kw, entry="serve_dense"
+        )
+    return engine
 
 
 # ---------------------------------------------------------------------------
@@ -883,6 +977,7 @@ def solve_lp_adaptive(
     warm_predictor=None,
     trace: bool = False,
     stats: Optional[dict] = None,
+    remedy=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.ipm.solve_lp_batch`: identical
@@ -900,7 +995,13 @@ def solve_lp_adaptive(
     `warm_predictor` (a `learn.WarmStartPredictor`) seeds lanes when no
     explicit `warm_start` is given; its seeds flow through the same
     per-lane safeguard, and any predictor degradation falls back to the
-    plain cold path (bitwise-identical to omitting it)."""
+    plain cold path (bitwise-identical to omitting it).
+
+    `remedy` (a `runtime.remedy.RemedyEngine` / `RemedyPolicy` / True)
+    runs the verdict-driven escalation ladder on lanes that retire
+    unhealthy, substituting recovered rows in place
+    (``stats["remediated"]`` records per-lane outcomes). Default None is
+    bitwise-identical to the historical path."""
     import jax
 
     from ..core.program import LPData
@@ -908,12 +1009,24 @@ def solve_lp_adaptive(
 
     base_ndim = {"A": 2, "b": 1, "c": 1, "l": 1, "u": 1, "c0": 0}
     axes, batch = _batch_axes(LPData, base_ndim, lp)
+    if remedy is not None:
+        from .remedy import as_remedy
+
+        remedy = as_remedy(remedy, solver_kw=solver_kw, entry="solve_lp")
     if warm_start is None and warm_predictor is not None:
         warm_start = _predict_warm(
             warm_predictor, LPData, lp, axes, batch, "solve_lp"
         )
     if batch is None:
-        return solve_lp(lp, warm_start=warm_start, trace=trace, **solver_kw)
+        out0 = solve_lp(lp, warm_start=warm_start, trace=trace, **solver_kw)
+        if remedy is None:
+            return out0
+        sol0, tr0 = out0 if trace else (out0, None)
+        sol0, tr0 = _apply_remedy(
+            remedy, LPData, lp, axes, None, sol0, tr0,
+            solver_kw.get("max_iter", 60), stats=stats,
+        )
+        return (sol0, tr0) if trace else sol0
     max_iter = solver_kw.get("max_iter", 60)
     d_axes = LPData(*axes)
     w_ax = None if warm_start is None else 0
@@ -941,6 +1054,10 @@ def solve_lp_adaptive(
         max_iter, chunk_iters, bucket_ladder(batch, ladder_base),
         warm_start, trace, stats, _opt_key(solver_kw),
     )
+    if remedy is not None:
+        out, tr = _apply_remedy(
+            remedy, LPData, lp, axes, batch, out, tr, max_iter, stats=stats
+        )
     return (out, tr) if trace else out
 
 
@@ -954,11 +1071,13 @@ def solve_lp_banded_adaptive(
     warm_predictor=None,
     trace: bool = False,
     stats: Optional[dict] = None,
+    remedy=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.structured.solve_lp_banded_batch`
     (same contract as `solve_lp_adaptive`, including `warm_predictor`
-    seeding with cold-path fallback; the year-scenario path)."""
+    seeding with cold-path fallback and the `remedy` escalation ladder on
+    unhealthy lanes; the year-scenario path)."""
     import jax
 
     from ..solvers.ipm import IPMSolution
@@ -969,14 +1088,28 @@ def solve_lp_banded_adaptive(
         "l": 2, "u": 2, "lb": 1, "ub": 1, "c0": 0,
     }
     axes, batch = _batch_axes(BandedLP, base_ndim, blp)
+    if remedy is not None:
+        from .remedy import as_remedy
+
+        remedy = as_remedy(
+            remedy, solver_kw=solver_kw, entry="solve_lp_banded"
+        )
     if warm_start is None and warm_predictor is not None:
         warm_start = _predict_warm(
             warm_predictor, BandedLP, blp, axes, batch, "solve_lp_banded"
         )
     if batch is None:
-        return solve_lp_banded(
+        out0 = solve_lp_banded(
             meta, blp, warm_start=warm_start, trace=trace, **solver_kw
         )
+        if remedy is None:
+            return out0
+        sol0, tr0 = out0 if trace else (out0, None)
+        sol0, tr0 = _apply_remedy(
+            remedy, BandedLP, blp, axes, None, sol0, tr0,
+            solver_kw.get("max_iter", 60), meta=meta, stats=stats,
+        )
+        return (sol0, tr0) if trace else sol0
     max_iter = solver_kw.get("max_iter", 60)
     d_axes = BandedLP(*axes)
     w_ax = None if warm_start is None else 0
@@ -1009,6 +1142,11 @@ def solve_lp_banded_adaptive(
         max_iter, chunk_iters, bucket_ladder(batch, ladder_base),
         warm_start, trace, stats, _opt_key(solver_kw),
     )
+    if remedy is not None:
+        out, tr = _apply_remedy(
+            remedy, BandedLP, blp, axes, batch, out, tr, max_iter,
+            meta=meta, stats=stats,
+        )
     return (out, tr) if trace else out
 
 
@@ -1021,6 +1159,7 @@ def solve_lp_pdhg_adaptive(
     warm_predictor=None,
     trace: bool = False,
     stats: Optional[dict] = None,
+    remedy=None,
     **solver_kw,
 ):
     """Adaptive-batch PDHG over a batch of `SparseLP`s sharing one
@@ -1028,9 +1167,10 @@ def solve_lp_pdhg_adaptive(
     ``cols`` broadcast). Same retirement/compaction contract as
     `solve_lp_adaptive` (including `warm_predictor` — PDHG seeds are the
     ``(x, y)`` slice of the prediction, projected/finiteness-checked by
-    the solver); `chunk_iters` is rounded up to a whole number of
-    convergence-check periods (`check_every`), since the PDHG outer loop
-    only observes the counter between checks."""
+    the solver — and the `remedy` ladder, whose lane-switch rung re-solves
+    a stuck PDHG lane through the dense IPM); `chunk_iters` is rounded up
+    to a whole number of convergence-check periods (`check_every`), since
+    the PDHG outer loop only observes the counter between checks."""
     import jax
 
     from ..core.program import SparseLP
@@ -1041,14 +1181,26 @@ def solve_lp_pdhg_adaptive(
         "c0": 0,
     }
     axes, batch = _batch_axes(SparseLP, base_ndim, lps)
+    if remedy is not None:
+        from .remedy import as_remedy
+
+        remedy = as_remedy(remedy, solver_kw=solver_kw, entry="solve_lp_pdhg")
     if warm_start is None and warm_predictor is not None:
         warm_start = _predict_warm(
             warm_predictor, SparseLP, lps, axes, batch, "solve_lp_pdhg"
         )
     if batch is None:
-        return solve_lp_pdhg(
+        out0 = solve_lp_pdhg(
             lps, warm_start=warm_start, trace=trace, **solver_kw
         )
+        if remedy is None:
+            return out0
+        sol0, tr0 = out0 if trace else (out0, None)
+        sol0, tr0 = _apply_remedy(
+            remedy, SparseLP, lps, axes, None, sol0, tr0,
+            solver_kw.get("max_iter", 100_000), stats=stats,
+        )
+        return (sol0, tr0) if trace else sol0
     if axes[0] == 0 or axes[1] == 0:
         raise ValueError(
             "solve_lp_pdhg_adaptive needs one shared sparsity pattern "
@@ -1088,6 +1240,11 @@ def solve_lp_pdhg_adaptive(
         max_iter, chunk_iters, bucket_ladder(batch, ladder_base),
         warm_start, trace, stats, _opt_key(solver_kw),
     )
+    if remedy is not None:
+        out, tr = _apply_remedy(
+            remedy, SparseLP, lps, axes, batch, out, tr, max_iter,
+            stats=stats,
+        )
     return (out, tr) if trace else out
 
 

@@ -1,0 +1,428 @@
+"""Self-healing solves: the verdict-driven remediation ladder.
+
+`obs/health.py` turns solver end-states and traces into verdicts
+(`diverged` / `stalled` / `cycling` / `nonfinite`), but until this module
+those verdicts were passive diagnostics: journaled, counted, and handed
+back to the caller unchanged. At fleet scale an unhealthy corner of the
+operating envelope is a certainty, not an edge case, so the serving tier
+needs an *answer* to numerical failure the way it already has one for
+process failure (crash domains + respawn in `serve/fleet.py`).
+
+The answer is an escalation ladder run on the host against the ONE lane
+that retired unhealthy, while the rest of the batch's results stand:
+
+1. ``cold`` — re-solve with the original options and no warm start. A
+   poisoned warm seed is the cheapest failure mode to cure, and even a
+   cold-started lane can recover here: the unbatched re-solve does not
+   share the batched-LAPACK rounding of its vmapped sibling, and a
+   fleet lane whose *result row* was corrupted in transit (e.g. the
+   ``nan`` chaos fault in `serve/shard.py`) is healthy again after one
+   honest re-solve.
+2. ``regularize`` — bump the IPM's primal/dual regularization
+   (`reg_p`/`reg_d`, `solvers/ipm.py`) by `RemedyPolicy.reg_scale` over
+   the dtype defaults: the classic fix for a singular/ill-conditioned
+   KKT system that took the iterates non-finite.
+3. ``float64`` — escalate an f32 problem to f64 (skipped when the
+   problem is already f64 or x64 is disabled): conditioning failures
+   that are terminal at 24 mantissa bits are routine at 53.
+4. ``lane_switch`` — change solver family: a dense LP re-solves through
+   the first-order PDHG lane (`solvers/pdhg.py`), a sparse PDHG problem
+   re-solves through the dense IPM. MPAX (PAPERS.md) makes the lanes
+   interchangeable on the same programs; what breaks a barrier method
+   (rank-deficient KKT) is invisible to a splitting method, and vice
+   versa. Banded problems skip this rung (no paired lane).
+5. give up — a new ``unrecoverable`` verdict, a flight-recorder capture
+   of the problem + options (`obs/recorder.py`), and the original
+   (unhealthy) solution row passed through so the caller still sees the
+   best iterate the solver had.
+
+Every rung is bounded by the per-request retry budget
+(`RemedyPolicy.max_attempts`) and, in the serve path, by the remaining
+deadline: a ladder that would answer after the deadline is worthless, so
+`remediate(deadline=...)` stops climbing the moment the clock runs out
+(final verdict stays the original — the deadline machinery owns that
+failure, not the ladder).
+
+Accounting: every rung tried increments
+``remediation_attempts_total{rung,entry}``; a rung that produces a
+healthy/slow verdict increments
+``remediation_recovered_total{verdict,rung}`` (labelled by the verdict
+it cured) and the ladder stops; each remediation emits one
+``remediation`` journal event recording the rung-by-rung history.
+
+Wired through the three adaptive entry points and the `SlotEngine`
+harvest (`runtime/adaptive.py`), the service resolvers
+(`serve/service.py`, `serve/fleet.py`), and the year-sweep runner
+(`workflow/runners.py`) — everywhere as an optional ``remedy=`` with
+default None, under the repo-wide contract that OFF is bitwise-identical
+to the historical path (asserted in tests/test_remedy.py).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+
+from ..obs import health as obs_health
+from ..obs import metrics as obs_metrics
+from ..obs import recorder as obs_recorder
+from ..obs.journal import get_tracer
+
+# verdicts the ladder knows how to attack; everything else (shed,
+# deadline_exceeded, hang, ...) is a policy/process failure, not a
+# numerical one, and re-solving would not change it
+REMEDIABLE = ("diverged", "stalled", "cycling", "nonfinite")
+
+# ladder order — cheapest first
+RUNGS = ("cold", "regularize", "float64", "lane_switch")
+
+obs_metrics.describe(
+    "remediation_attempts_total",
+    "Remediation ladder rungs tried, by rung and entry point.",
+)
+obs_metrics.describe(
+    "remediation_recovered_total",
+    "Unhealthy solves recovered by the ladder, by original verdict and "
+    "winning rung.",
+)
+
+
+class RemedyPolicy(NamedTuple):
+    """Knobs of the escalation ladder. The default policy climbs all four
+    rungs; `max_attempts` is the per-request retry budget (rungs tried,
+    counting skipped rungs as free)."""
+
+    max_attempts: int = 4
+    reg_scale: float = 1e3  # rung-2 multiplier over the dtype reg defaults
+    allow_f64: bool = True
+    allow_lane_switch: bool = True
+    deadline_margin: float = 0.0  # stop climbing this early (seconds)
+
+
+class RemedyOutcome(NamedTuple):
+    solution: Any  # recovered row (rung set), else the original-path row
+    verdict: Any  # final obs_health.Verdict
+    rung: Optional[str]  # winning rung name; None when not recovered
+    attempts: int  # rungs actually solved
+    history: tuple  # ((rung, resulting verdict or note), ...)
+
+    @property
+    def recovered(self) -> bool:
+        return self.rung is not None
+
+
+def as_remedy(spec, *, solver_kw=None, entry="solve_lp", clock=None):
+    """Coerce a user-facing ``remedy=`` argument into a `RemedyEngine`
+    (or None). Accepts None, True (default policy), a `RemedyPolicy`, a
+    policy-kwargs dict, or an already-built engine (returned as-is, its
+    own solver_kw/clock respected)."""
+    if spec is None:
+        return None
+    if isinstance(spec, RemedyEngine):
+        return spec
+    if spec is True:
+        spec = RemedyPolicy()
+    elif isinstance(spec, dict):
+        spec = RemedyPolicy(**spec)
+    return RemedyEngine(spec, solver_kw=solver_kw, entry=entry, clock=clock)
+
+
+class RemedyEngine:
+    """One remediation policy bound to the solver options of the path it
+    heals. Host-side and stateless between calls — safe to share across
+    lanes/requests of one service; each `remediate()` call compiles (or
+    reuses) the unbatched re-solve executables for its problem shape."""
+
+    def __init__(
+        self,
+        policy: Optional[RemedyPolicy] = None,
+        *,
+        solver_kw: Optional[dict] = None,
+        entry: str = "solve_lp",
+        clock=None,
+    ):
+        self.policy = policy or RemedyPolicy()
+        self.solver_kw = dict(solver_kw or {})
+        # the ladder re-solves plainly; a trace-returning solve would
+        # change the (solution, budget) plumbing below for no benefit
+        self.solver_kw.pop("trace", None)
+        self.entry = entry
+        self.clock = clock or time.monotonic
+
+    # -- public API -----------------------------------------------------
+    def remediate(
+        self,
+        problem,
+        verdict,
+        *,
+        deadline: Optional[float] = None,
+        request_id=None,
+        meta=None,
+    ) -> "RemedyOutcome":
+        """Run the ladder for ONE unbatched problem (`LPData`, `SparseLP`,
+        or `BandedLP` + its `meta`) whose solve earned `verdict`. Returns
+        a `RemedyOutcome`; `outcome.solution` is a single-lane solution
+        row shaped/dtyped like the original path's row, so callers can
+        substitute it in place. Never raises: a rung whose re-solve blows
+        up is recorded in the history and the ladder climbs on."""
+        pol = self.policy
+        original = getattr(verdict, "verdict", str(verdict))
+        kind = type(problem).__name__
+        history = []
+        attempts = 0
+        won = None
+        sol = None
+        for rung in RUNGS:
+            if attempts >= pol.max_attempts:
+                break
+            if deadline is not None and (
+                self.clock() >= deadline - pol.deadline_margin
+            ):
+                history.append((rung, "deadline"))
+                break
+            runner = getattr(self, f"_rung_{rung}")
+            try:
+                result = runner(kind, problem, meta)
+            except Exception as e:  # a broken rung must not kill the solve
+                result = f"error:{type(e).__name__}"
+            if isinstance(result, str):  # rung skipped / inapplicable
+                history.append((rung, result))
+                continue
+            attempts += 1
+            obs_metrics.inc(
+                "remediation_attempts_total", rung=rung, entry=self.entry
+            )
+            cand, budget = result
+            v = obs_health.classify_solution(cand, budget=budget)
+            name = v[0].verdict if v else "unknown"
+            history.append((rung, name))
+            if name in ("healthy", "slow"):
+                won, sol = rung, cand
+                break
+        recovered = won is not None
+        if recovered:
+            obs_metrics.inc(
+                "remediation_recovered_total", verdict=original, rung=won
+            )
+            final = obs_health.Verdict(
+                "healthy", None, None, f"remediated ({won}) from {original}"
+            )
+        elif any(note == "deadline" for _, note in history):
+            final = verdict  # deadline machinery owns this failure
+        else:
+            detail = (
+                f"remediation ladder exhausted after {attempts} attempts "
+                f"(original: {original}; "
+                + ", ".join(f"{r}={n}" for r, n in history) + ")"
+            )
+            final = obs_health.Verdict(
+                "unrecoverable",
+                getattr(verdict, "first_bad_iteration", None),
+                getattr(verdict, "quantity", None),
+                detail,
+            )
+            obs_recorder.maybe_capture(
+                self.entry,
+                verdict=final,
+                problem=problem,
+                options=dict(self.solver_kw),
+                extra={
+                    "remediation": [list(h) for h in history],
+                    "request_id": request_id,
+                },
+            )
+        get_tracer().event(
+            "remediation",
+            entry=self.entry,
+            original=original,
+            recovered=recovered,
+            rung=won,
+            attempts=attempts,
+            rungs=[f"{r}:{n}" for r, n in history],
+            request_id=request_id,
+        )
+        return RemedyOutcome(sol, final, won, attempts, tuple(history))
+
+    def remediate_solution_row(
+        self,
+        problem,
+        row,
+        *,
+        budget: Optional[int] = None,
+        deadline: Optional[float] = None,
+        request_id=None,
+        meta=None,
+    ):
+        """Classify one harvested solution row and run the ladder when the
+        verdict is remediable. Returns ``(row, info)`` — the (possibly
+        replaced) row plus a JSON-safe info dict, or ``(row, None)`` when
+        nothing needed doing. The `SlotEngine` harvest hook."""
+        vs = obs_health.classify_solution(row, budget=budget)
+        v = vs[0] if vs else None
+        if v is None or v.verdict not in REMEDIABLE:
+            return row, None
+        out = self.remediate(
+            problem, v, deadline=deadline, request_id=request_id, meta=meta
+        )
+        info = {
+            "original": v.verdict,
+            "verdict": out.verdict.verdict,
+            "rung": out.rung,
+            "attempts": out.attempts,
+            "recovered": out.recovered,
+        }
+        return (out.solution if out.recovered else row), info
+
+    # -- the rungs ------------------------------------------------------
+    # Each returns (solution, classify_budget), or a short string naming
+    # why the rung does not apply to this problem kind.
+
+    def _rung_cold(self, kind, problem, meta):
+        return self._native_solve(kind, problem, meta, self.solver_kw)
+
+    def _rung_regularize(self, kind, problem, meta):
+        if kind == "SparseLP":
+            return "no_reg_knob"  # PDHG has no KKT regularization
+        kw = dict(self.solver_kw)
+        f64 = np.asarray(problem.b).dtype == np.float64
+        # user-supplied reg (including an explicit 0.0) escalates FROM the
+        # dtype defaults, not from itself: 0 * scale would change nothing
+        rp = kw.get("reg_p") or (1e-13 if f64 else 1e-8)
+        rd = kw.get("reg_d") or (1e-12 if f64 else 1e-7)
+        kw["reg_p"] = float(rp) * self.policy.reg_scale
+        kw["reg_d"] = float(rd) * self.policy.reg_scale
+        return self._native_solve(kind, problem, meta, kw)
+
+    def _rung_float64(self, kind, problem, meta):
+        if not self.policy.allow_f64:
+            return "disabled"
+        if np.asarray(problem.b).dtype == np.float64:
+            return "already_f64"
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            return "x64_disabled"
+        dtype = np.asarray(problem.b).dtype
+        wide = _cast_floats(problem, np.float64)
+        sol, budget = self._native_solve(kind, wide, meta, self.solver_kw)
+        return _cast_floats(sol, dtype), budget
+
+    def _rung_lane_switch(self, kind, problem, meta):
+        if not self.policy.allow_lane_switch:
+            return "disabled"
+        if kind == "BandedLP":
+            return "no_paired_lane"
+        if kind == "SparseLP":
+            return self._switch_to_ipm(problem)
+        return self._switch_to_pdhg(problem)
+
+    # -- solve plumbing -------------------------------------------------
+    def _native_solve(self, kind, problem, meta, kw):
+        if kind == "BandedLP":
+            from ..solvers.structured import solve_lp_banded
+
+            return solve_lp_banded(meta, problem, **kw), kw.get("max_iter", 60)
+        if kind == "SparseLP":
+            from ..solvers.pdhg import solve_lp_pdhg
+
+            return solve_lp_pdhg(problem, **kw), kw.get("max_iter", 100_000)
+        from ..solvers.ipm import solve_lp
+
+        return solve_lp(problem, **kw), kw.get("max_iter", 60)
+
+    def _switch_to_pdhg(self, lp):
+        """Dense IPM lane -> first-order PDHG lane. The PDHG solution is
+        classified natively, then mapped back into the IPM row shape
+        (bound duals recovered from the reduced costs) so the caller's
+        batch stays homogeneous."""
+        from ..core.program import SparseLP
+        from ..solvers.pdhg import solve_lp_pdhg
+
+        A = np.asarray(lp.A)
+        rows, cols = np.nonzero(A)
+        slp = SparseLP(
+            rows.astype(np.int32), cols.astype(np.int32),
+            A[rows, cols], lp.b, lp.c, lp.l, lp.u, lp.c0,
+        )
+        tol = max(float(self.solver_kw.get("tol") or 1e-6), 1e-6)
+        sol = solve_lp_pdhg(slp, tol=tol)
+        v = obs_health.classify_solution(sol, budget=100_000)
+        if v and v[0].verdict in ("healthy", "slow"):
+            return _ipm_row_from_pdhg(sol, lp), None  # healthy by mapping
+        return sol, 100_000  # let the caller's classify reject it
+
+    def _switch_to_ipm(self, slp):
+        """Sparse PDHG lane -> dense IPM lane (densify the pattern)."""
+        from ..core.program import LPData
+        from ..solvers.ipm import solve_lp
+
+        m = int(np.asarray(slp.b).shape[-1])
+        n = int(np.asarray(slp.c).shape[-1])
+        A = np.zeros((m, n), np.asarray(slp.vals).dtype)
+        A[np.asarray(slp.rows), np.asarray(slp.cols)] = np.asarray(slp.vals)
+        lp = LPData(A, slp.b, slp.c, slp.l, slp.u, slp.c0)
+        tol = float(self.solver_kw.get("tol") or 1e-8)
+        sol = solve_lp(lp, tol=tol)
+        v = obs_health.classify_solution(sol, budget=60)
+        if v and v[0].verdict in ("healthy", "slow"):
+            return _pdhg_row_from_ipm(sol, slp), None
+        return sol, 60
+
+
+def _cast_floats(tree, dtype):
+    """Cast the float leaves of a problem/solution NamedTuple, leaving
+    index/flag/count leaves untouched."""
+    out = []
+    for a in tree:
+        a_np = np.asarray(a)
+        out.append(
+            a_np.astype(dtype)
+            if np.issubdtype(a_np.dtype, np.floating) else a_np
+        )
+    return type(tree)(*out)
+
+
+def _ipm_row_from_pdhg(psol, lp):
+    """PDHGSolution -> IPMSolution row for a dense LP: recover the bound
+    duals from the reduced costs ``z = c - A^T y`` (zl takes the positive
+    part on finitely-lower-bounded columns, zu the negative part on
+    finitely-upper-bounded ones) and report the complementarity gap those
+    duals imply."""
+    from ..solvers.ipm import IPMSolution
+
+    dt = np.asarray(lp.b).dtype
+    x = np.asarray(psol.x, dt)
+    y = np.asarray(psol.y, dt)
+    A = np.asarray(lp.A, dt)
+    l = np.asarray(lp.l, dt)
+    u = np.asarray(lp.u, dt)
+    z = np.asarray(lp.c, dt) - A.T @ y
+    zl = np.where(np.isfinite(l), np.clip(z, 0.0, None), 0.0).astype(dt)
+    zu = np.where(np.isfinite(u), np.clip(-z, 0.0, None), 0.0).astype(dt)
+    comp = float(
+        np.sum(np.where(np.isfinite(l), (x - l) * zl, 0.0))
+        + np.sum(np.where(np.isfinite(u), (u - x) * zu, 0.0))
+    )
+    gap = np.asarray(comp / (1.0 + abs(float(psol.obj))), dt)
+    conv = np.asarray(psol.converged, bool)
+    return IPMSolution(
+        x, y, zl, zu, np.asarray(psol.obj, dt), conv,
+        np.asarray(psol.iterations, np.int32),
+        np.asarray(psol.res_primal, dt), np.asarray(psol.res_dual, dt),
+        gap, np.asarray(0 if bool(conv) else 1, np.int32),
+    )
+
+
+def _pdhg_row_from_ipm(isol, slp):
+    """IPMSolution -> PDHGSolution row for a sparse LP (drop the bound
+    duals; the fields map one-to-one otherwise)."""
+    from ..solvers.pdhg import PDHGSolution
+
+    dt = np.asarray(slp.b).dtype
+    return PDHGSolution(
+        np.asarray(isol.x, dt), np.asarray(isol.y, dt),
+        np.asarray(isol.obj, dt), np.asarray(isol.converged, bool),
+        np.asarray(isol.iterations, np.int32),
+        np.asarray(isol.res_primal, dt), np.asarray(isol.res_dual, dt),
+    )

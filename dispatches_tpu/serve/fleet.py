@@ -46,8 +46,10 @@ from typing import Any, Dict, List, Optional
 
 from ..obs import health as obs_health
 from ..obs import metrics as obs_metrics
+from ..obs import recorder as obs_recorder
 from ..obs import reqtrace as obs_reqtrace
 from ..obs.journal import get_tracer
+from ..runtime.remedy import REMEDIABLE, as_remedy
 from .cache import ResultCache
 from .queue import FairQueue, TenantConfig
 from .request import SolveResult, SolveRequest, Ticket, priority_name, priority_value
@@ -105,6 +107,12 @@ obs_metrics.describe(
     "Telemetry frames dropped because their snapshot failed to merge "
     "(malformed series/buckets).",
 )
+obs_metrics.describe(
+    "poisoned_requests_total",
+    "Requests quarantined as `poisoned`: their dispatches kept killing "
+    "shards until the max_requeues cap, so the fleet stopped requeueing "
+    "them instead of letting one request take every shard down in turn.",
+)
 
 
 class _ShardSlot:
@@ -140,6 +148,8 @@ class FleetService:
         respawn_backoff_cap: float = 30.0,
         stable_after: float = 10.0,
         spawn: bool = True,
+        max_requeues: int = 2,
+        remedy=None,
     ):
         if not shards:
             raise ValueError("a fleet needs at least one shard")
@@ -163,6 +173,17 @@ class FleetService:
 
         ref = shards[0]
         self._fp_serve = ("serve_dense", ref.bucket, _opt_key(ref.solver_kw))
+        # poison quarantine: a request may be crash-requeued at most this
+        # many times before it resolves as `poisoned` instead of getting
+        # yet another shard to kill (see _fail_shard)
+        self.max_requeues = int(max_requeues)
+        # parent-side remediation ladder (runtime/remedy.py): shard
+        # children stay remedy-free — the parent owns the deadline clock
+        # and the journal, so an unhealthy harvested row re-solves here
+        self.remedy = as_remedy(
+            remedy, solver_kw=ref.solver_kw, entry="serve_fleet",
+            clock=clock,
+        )
         self._lock = threading.RLock()
         self._seq = 0
         self._thread: Optional[threading.Thread] = None
@@ -172,6 +193,7 @@ class FleetService:
         self.deadline_total = 0
         self.respawn_total = 0
         self.requeued_total = 0
+        self.poisoned_total = 0
         self.tenant_shed: Dict[str, int] = {}
         # per-shard completion tallies (S6: loadgen/bench per-shard rows)
         self.per_shard: Dict[int, Dict[str, float]] = {}
@@ -194,11 +216,15 @@ class FleetService:
         request_id: Optional[str] = None,
         tenant: str = "default",
         trace_ctx: Any = None,
+        fault: Optional[str] = None,
     ) -> Ticket:
         """Queue one problem row; same contract as
         `DispatchService.submit` plus `tenant` (fairness/rate-limit id).
         A request over its tenant's token-bucket rate resolves
-        synchronously with the ``shed_tenant_quota`` verdict."""
+        synchronously with the ``shed_tenant_quota`` verdict. `fault` is
+        the chaos hook: a payload riding the dispatch frame into the
+        shard child (``"exit"`` kills the worker mid-dispatch) — the
+        loadgen/test plumbing that exercises the poison quarantine."""
         now = self.clock()
         if deadline is None and timeout is not None:
             deadline = now + timeout
@@ -209,6 +235,7 @@ class FleetService:
             fingerprint=self._fingerprint(problem, fingerprint, options),
             request_id=request_id,
             tenant=tenant,
+            fault=fault,
         )
         if self.reqtrace:
             req.journey = obs_reqtrace.start_journey(
@@ -378,14 +405,23 @@ class FleetService:
 
     def _fail_shard(self, slot: _ShardSlot, reason: str, exit_code=None) -> None:
         """Down a shard: requeue its in-flight lanes, schedule the
-        respawn with the current backoff, double the backoff (capped)."""
+        respawn with the current backoff, double the backoff (capped).
+
+        The crash is attributed to every in-flight ticket: a request
+        already crash-requeued `max_requeues` times is quarantined as
+        ``poisoned`` instead of requeued — one poison payload must not
+        get to kill every respawn in turn."""
         shard = slot.shard
-        requeued = list(shard.lanes.values())
+        inflight = list(shard.lanes.values())
         shard.lanes.clear()
         shard.kill()
-        for req in requeued:
-            self.queue.requeue(req)
-        n = len(requeued)
+        n = 0
+        for req in inflight:
+            if req.requeues >= self.max_requeues:
+                self._resolve_poisoned(req, shard=shard.shard_id, reason=reason)
+                continue
+            self.queue.requeue(req)  # increments req.requeues
+            n += 1
         if n:
             self.requeued_total += n
             obs_metrics.inc(
@@ -401,6 +437,7 @@ class FleetService:
         get_tracer().event(
             "shard_down", shard=shard.shard_id, reason=reason,
             exit_code=exit_code, requeued_lanes=n,
+            poisoned_lanes=len(inflight) - n,
             respawn_in_s=round(slot.respawn_at - time.monotonic(), 3),
         )
 
@@ -678,6 +715,18 @@ class FleetService:
         )
         verdicts = obs_health.classify_solution(row)
         verdict = verdicts[0].verdict if verdicts else "healthy"
+        rinfo = None
+        if self.remedy is not None and verdict in REMEDIABLE:
+            # parent-side ladder: the child's row came back unhealthy, so
+            # re-solve here where the deadline clock and journal live.
+            # `budget` is the shard engines' shared iteration cap.
+            row, rinfo = self.remedy.remediate_solution_row(
+                req.problem, row,
+                budget=self._slots[0].shard.solver_kw.get("max_iter", 60),
+                deadline=req.deadline, request_id=req.request_id,
+            )
+            if rinfo is not None:
+                verdict = rinfo["verdict"]
         result = SolveResult(
             solution=row,
             verdict=verdict,
@@ -686,17 +735,21 @@ class FleetService:
             request_id=req.request_id,
         )
         if self.cache is not None and verdict in ("healthy", "slow"):
+            # ladder-exhausted (`unrecoverable`) rows never enter the
+            # cache: a bad answer must not become a future cache hit
             self.cache.put(req.fingerprint, result)
-        obs_metrics.inc("serve_requests_total", status="ok")
+        status = "unrecoverable" if verdict == "unrecoverable" else "ok"
+        obs_metrics.inc("serve_requests_total", status=status)
         obs_metrics.observe(
             "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
-            status="ok",
+            status=status,
         )
+        extra = {"remediation": rinfo} if rinfo is not None else {}
         get_tracer().solve_event(
             self.name, row,
             request_id=req.request_id, seq=req.seq,
             latency_s=latency, iterations=iterations, shard=shard,
-            **(warm_attrs or {}),
+            **(warm_attrs or {}), **extra,
         )
         if req.journey is not None:
             # started_at re-stamps on every dispatch, so a requeued
@@ -814,6 +867,46 @@ class FleetService:
             request_id=req.request_id,
         ))
 
+    def _resolve_poisoned(self, req, *, shard: int, reason: str) -> None:
+        """Quarantine one request whose dispatches keep downing shards:
+        it resolves as ``poisoned`` (no solution — its iterate died with
+        the shard every time) instead of going back to the queue. A
+        flight-recorder capture keeps the problem for offline triage."""
+        self.completed += 1
+        self.poisoned_total += 1
+        now = self.clock()
+        latency = now - req.submitted_at
+        obs_metrics.inc("serve_requests_total", status="poisoned")
+        obs_metrics.inc("poisoned_requests_total")
+        detail = (
+            f"quarantined after {req.requeues} crash requeues "
+            f"(max_requeues={self.max_requeues}); last shard {shard} "
+            f"down: {reason}"
+        )
+        get_tracer().event(
+            "serve_poisoned", verdict="poisoned",
+            request_id=req.request_id, seq=req.seq, tenant=req.tenant,
+            shard=shard, requeues=req.requeues, detail=detail,
+        )
+        obs_health.note_verdicts({"poisoned": 1}, solve=self.name)
+        obs_recorder.maybe_capture(
+            self.name,
+            verdict=obs_health.Verdict("poisoned", None, None, detail),
+            problem=req.problem,
+            extra={"request_id": req.request_id, "requeues": req.requeues},
+        )
+        if req.journey is not None:
+            req.journey.finish(
+                "poisoned", verdict="poisoned", now=now,
+                **self._finish_extra(req),
+            )
+        req.ticket._complete(SolveResult(
+            solution=None,
+            verdict="poisoned",
+            latency=latency,
+            request_id=req.request_id,
+        ))
+
     # -- introspection -------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
@@ -826,6 +919,7 @@ class FleetService:
                 "deadline_exceeded": self.deadline_total,
                 "respawns": self.respawn_total,
                 "requeued_lanes": self.requeued_total,
+                "poisoned": self.poisoned_total,
                 "tenant_shed": dict(self.tenant_shed),
                 "telemetry_frames": self.telemetry_frames,
                 "telemetry_errors": self.telemetry_errors,
@@ -881,7 +975,8 @@ def make_dense_fleet(
     enough (`parallel.mesh.shard_device_env`); on single-device hosts
     they are plain subprocess crash domains sharing the device.
     `fleet_kw` passes through to `FleetService` (heartbeats, backoff,
-    tenants...); solver options ride `fleet_kw.pop('solver_kw')`.
+    tenants, the ``max_requeues`` poison cap, the ``remedy=`` remediation
+    ladder...); solver options ride `fleet_kw.pop('solver_kw')`.
     ``telemetry=True`` spawns children with ``--telemetry`` (metrics +
     journal deltas ride the heartbeat back into the parent registry);
     ``reqtrace=True`` additionally makes children attach chunk-loop

@@ -47,7 +47,10 @@ class SolveResult(NamedTuple):
     bucket size), or None when the request was shed / expired before its
     first chunk. `verdict` follows `obs.health.SEVERITY` — the service
     adds ``deadline_exceeded`` (late; `solution` holds the best iterate
-    the solver had, when any) and ``shed`` (never attempted)."""
+    the solver had, when any), ``shed`` (never attempted), ``poisoned``
+    (quarantined by the fleet after repeated crash-correlated dispatches;
+    no solution), and ``unrecoverable`` (the remediation ladder gave up;
+    `solution` holds the original unhealthy iterate)."""
 
     solution: Any
     verdict: str
@@ -59,7 +62,7 @@ class SolveResult(NamedTuple):
     @property
     def ok(self) -> bool:
         return self.solution is not None and self.verdict not in (
-            "shed", "deadline_exceeded",
+            "shed", "deadline_exceeded", "poisoned", "unrecoverable",
         )
 
 
@@ -67,7 +70,7 @@ class SolveRequest:
     __slots__ = (
         "problem", "priority", "deadline", "fingerprint", "request_id",
         "seq", "submitted_at", "started_at", "ticket", "journey",
-        "tenant", "requeues",
+        "tenant", "requeues", "fault",
     )
 
     def __init__(
@@ -79,6 +82,7 @@ class SolveRequest:
         fingerprint: Optional[str] = None,
         request_id: Optional[str] = None,
         tenant: str = "default",
+        fault: Optional[str] = None,
     ):
         self.problem = problem
         self.priority = int(priority)
@@ -95,8 +99,14 @@ class SolveRequest:
         self.journey: Optional[Any] = None
         # times a crashed/wedged shard handed this request back to the
         # queue (fleet bookkeeping; a requeued lane re-solves from
-        # iteration 0, so its result stays bitwise-identical)
+        # iteration 0, so its result stays bitwise-identical). Capped by
+        # FleetService.max_requeues — a request whose dispatches keep
+        # killing shards is quarantined as `poisoned` instead.
         self.requeues: int = 0
+        # chaos hook: a fault-injection payload riding the solve frame to
+        # the shard child ("exit" kills the worker mid-dispatch). Test
+        # plumbing for the poison-quarantine path; never set in production
+        self.fault = fault
 
     def sort_key(self):
         # FIFO within a priority class; seq is service-assigned and unique

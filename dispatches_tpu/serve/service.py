@@ -352,6 +352,21 @@ class DispatchService:
         latency = now - req.submitted_at
         verdicts = obs_health.classify_solution(row)
         verdict = verdicts[0].verdict if verdicts else "healthy"
+        # the engine's remediation ladder (runtime/remedy.py) already ran
+        # in the harvest; its outcome rides in `stats`. A recovered row
+        # classifies healthy above; an exhausted ladder escalates the
+        # verdict to `unrecoverable` so callers and caches can tell
+        # "solver struggled" from "the system gave up".
+        rinfo = stats.get("remediation")
+        health = None
+        if rinfo is not None and rinfo.get("verdict") == "unrecoverable":
+            verdict = "unrecoverable"
+            health = _service_health(
+                "unrecoverable",
+                f"remediation ladder exhausted "
+                f"({rinfo.get('attempts', 0)} attempts, "
+                f"original: {rinfo.get('original')})",
+            )
         result = SolveResult(
             solution=row,
             verdict=verdict,
@@ -359,21 +374,26 @@ class DispatchService:
             latency=latency,
             request_id=req.request_id,
         )
-        if self.cache is not None:
+        if self.cache is not None and verdict != "unrecoverable":
+            # a ladder-exhausted answer must not become a future cache hit
             self.cache.put(req.fingerprint, result)
-        obs_metrics.inc("serve_requests_total", status="ok")
+        status = "unrecoverable" if verdict == "unrecoverable" else "ok"
+        obs_metrics.inc("serve_requests_total", status=status)
         obs_metrics.observe(
             "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
-            status="ok",
+            status=status,
         )
         warm_attrs = {
             k: stats[k]
             for k in ("warm_source", "warm_accepted") if k in stats
         }
+        if rinfo is not None:
+            warm_attrs["remediation"] = rinfo
         get_tracer().solve_event(
             self.name, row,
             request_id=req.request_id, seq=req.seq,
             latency_s=latency, iterations=stats.get("iterations"),
+            **({"health": health} if health is not None else {}),
             **warm_attrs,
         )
         if req.journey is not None:
@@ -490,6 +510,7 @@ def make_dense_service(
     trace: bool = False,
     reqtrace: bool = False,
     warm_model=None,
+    remedy=None,
     **solver_kw,
 ) -> DispatchService:
     """A `DispatchService` over dense `LPData` rows solved by the IPM:
@@ -500,12 +521,27 @@ def make_dense_service(
     `warm_model` (default None = today's cold path, bitwise-identical)
     is a learned warm-start artifact path / `WarmStartModel` /
     `WarmStartPredictor`; cold dispatches are then seeded through the
-    solver's safeguarded ``warm_start=`` plumbing."""
+    solver's safeguarded ``warm_start=`` plumbing.
+
+    `remedy` (a `runtime.remedy.RemedyEngine` / `RemedyPolicy` / True;
+    default None = untouched harvest, bitwise-identical) re-solves lanes
+    that retire unhealthy up the escalation ladder, bounded by the
+    request's remaining deadline on the service clock
+    (docs/serving.md "Self-healing & quarantine")."""
     from ..runtime.adaptive import make_dense_engine
 
+    remedy_engine = None
+    if remedy is not None:
+        from ..runtime.remedy import as_remedy
+
+        rkw = dict(solver_kw)
+        rkw.setdefault("max_iter", 60)
+        remedy_engine = as_remedy(
+            remedy, solver_kw=rkw, entry="serve_dense", clock=clock
+        )
     engine = make_dense_engine(
         bucket, chunk_iters=chunk_iters, trace=trace,
-        warm_predictor=warm_model, **solver_kw
+        warm_predictor=warm_model, remedy=remedy_engine, **solver_kw
     )
     cache = ResultCache(cache_size) if cache_size else None
     return DispatchService(

@@ -456,6 +456,11 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
             return 0
         while pending and engine.free_slots():
             msg = pending.pop(0)
+            if msg.get("fault") == "exit":
+                # poison payload: die mid-dispatch, after accepting the
+                # frame but before any result can be produced — the parent
+                # sees a crash with this lane in flight and attributes it
+                os._exit(13)
             row = decode_row(msg["problem"])
             slots[msg["lane"]] = engine.admit(msg["lane"], row)
         for lane, row, stats in engine.step() if engine.active() else ():
@@ -664,9 +669,12 @@ class ShardProcess:
         """Dispatch one request; tracks it in `lanes` until a result
         arrives or the fleet requeues it. Returns False (without
         tracking) when the pipe is already dead."""
-        ok = self._send({
+        frame = {
             "op": "solve", "lane": lane, "problem": encode_row(req.problem),
-        })
+        }
+        if getattr(req, "fault", None):
+            frame["fault"] = req.fault  # chaos payload rides the dispatch
+        ok = self._send(frame)
         if ok:
             self.lanes[lane] = req
         return ok

@@ -224,6 +224,7 @@ def run_year_sweep(
     cost: bool = False,
     warm_starts: bool = False,
     adaptive: bool = False,
+    remedy=None,
 ):
     """Year-scale LMP-scenario design sweep — the BASELINE.md north-star
     workload as a user entry point: N full-year (8,760 h) wind+battery+PEM
@@ -265,7 +266,15 @@ def run_year_sweep(
     `runtime.adaptive.solve_lp_banded_adaptive` — converged lanes retire
     early and the batch compacts to the bucket ladder; per-batch driver
     stats ride on the journal solve events. Both default OFF, leaving
-    the historical solve path untouched bitwise."""
+    the historical solve path untouched bitwise.
+
+    `remedy` (CLI `--remedy`, requires `adaptive`) arms the
+    `runtime.remedy` escalation ladder on the adaptive path: a scenario
+    lane that retires `diverged`/`stalled`/`cycling`/`nonfinite` is
+    re-solved on the host (cold -> regularize -> f64 -> lane switch)
+    before the batch's results land; per-batch remediation outcomes ride
+    the journal solve events under ``adaptive_stats.remediated``. Default None
+    keeps the sweep bitwise-identical to the remedy-free path."""
     import time as _time
 
     import jax
@@ -411,7 +420,7 @@ def run_year_sweep(
 
                     solve_out = solve_lp_banded_adaptive(
                         meta, blp_b, warm_start=warm_b, trace=trace,
-                        stats=ad_stats, **solver_kw
+                        stats=ad_stats, remedy=remedy, **solver_kw
                     )
                 else:
                     solve_out = solve_lp_banded_batch(
@@ -672,6 +681,12 @@ def main(argv=None):
         "chunks and compact the batch (runtime.adaptive)",
     )
     ys.add_argument(
+        "--remedy", action="store_true",
+        help="arm the remediation ladder on unhealthy adaptive lanes "
+        "(cold retry -> regularize -> f64 -> lane switch; runtime.remedy; "
+        "requires --adaptive)",
+    )
+    ys.add_argument(
         "--cost", action="store_true",
         help="attach XLA cost-model FLOPs/bytes/memory + roofline records "
         "to journal solve events (compiles the solver once more; obs.cost)",
@@ -683,6 +698,9 @@ def main(argv=None):
     )
 
     args = p.parse_args(argv)
+    if getattr(args, "remedy", False) and not args.adaptive:
+        p.error("--remedy requires --adaptive (the ladder hooks the "
+                "adaptive driver's lane verdicts)")
     from ..runtime.adaptive import enable_persistent_cache
 
     # no-op unless --cache-dir or DISPATCHES_TPU_CACHE_DIR is set; safe
@@ -753,6 +771,7 @@ def main(argv=None):
                     cost=args.cost,
                     warm_starts=args.warm_starts,
                     adaptive=args.adaptive,
+                    remedy=True if args.remedy else None,
                 )
     finally:
         if recorder is not None:

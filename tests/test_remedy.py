@@ -1,0 +1,223 @@
+"""Self-healing solves: the `runtime/remedy.py` verdict-driven
+escalation ladder — rung unit contracts on a reproducibly-stalling LP,
+`as_remedy` coercions, retry/deadline bounds — plus its wiring through
+`solve_lp_adaptive` (per-lane substitution + stats/journal/metrics) and
+`make_dense_service`. The OFF path (`remedy=None`, the default) must
+stay bitwise-identical to the historical solve. Fleet-side quarantine
+tests live in tests/test_serve_fleet.py next to the shard stubs."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.obs import health as obs_health
+from dispatches_tpu.obs import metrics as obs_metrics
+from dispatches_tpu.obs.journal import Tracer, read_journal, use_tracer
+from dispatches_tpu.obs.metrics import reset_metrics
+from dispatches_tpu.runtime.adaptive import solve_lp_adaptive
+from dispatches_tpu.runtime.remedy import (
+    REMEDIABLE,
+    RemedyEngine,
+    RemedyOutcome,
+    RemedyPolicy,
+    as_remedy,
+)
+from dispatches_tpu.serve import make_dense_service
+from dispatches_tpu.solvers.ipm import solve_lp
+
+# An unregularized IPM stalls on this rank-deficient system (the normal
+# equations go singular): with reg_p=reg_d=0.0 the solve retires
+# "stalled", and rung 2 (restore regularization) cures it. This is the
+# deterministic sick patient every test below re-uses.
+_SICK_KW = dict(tol=1e-8, max_iter=60, reg_p=0.0, reg_d=0.0)
+
+
+def _sick_lp(dtype=jnp.float64):
+    return LPData(
+        jnp.asarray([[1.0, 1.0], [1.0, 1.0]], dtype),
+        jnp.asarray([1.0, 1.0], dtype),
+        jnp.asarray([1.0, 2.0], dtype),
+        jnp.zeros(2, dtype), jnp.full(2, 10.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _healthy_lp(dtype=jnp.float64):
+    # same (M, N) as the sick one, full rank: solves fine unregularized
+    return LPData(
+        jnp.asarray([[1.0, 0.0], [0.0, 1.0]], dtype),
+        jnp.asarray([1.0, 1.0], dtype),
+        jnp.asarray([1.0, 1.0], dtype),
+        jnp.zeros(2, dtype), jnp.full(2, 10.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _sick_verdict(lp):
+    sol = solve_lp(lp, **_SICK_KW)
+    v = obs_health.classify_solution(sol, budget=_SICK_KW["max_iter"])[0]
+    return sol, v
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+def _recovered_total():
+    counters = obs_metrics.snapshot().get("counters", {})
+    return sum(
+        v for k, v in counters.items()
+        if k.startswith("remediation_recovered_total")
+    )
+
+
+# ---------------------------------------------------------------------
+# the ladder itself
+# ---------------------------------------------------------------------
+class TestLadder:
+    def test_fixture_stalls_and_is_remediable(self):
+        _, v = _sick_verdict(_sick_lp())
+        assert v.verdict == "stalled"
+        assert v.verdict in REMEDIABLE
+
+    def test_regularize_rung_recovers_stalled(self):
+        lp = _sick_lp()
+        _, v = _sick_verdict(lp)
+        eng = RemedyEngine(solver_kw=dict(_SICK_KW), entry="test")
+        out = eng.remediate(lp, v)
+        assert isinstance(out, RemedyOutcome)
+        assert out.recovered and out.verdict.verdict == "healthy"
+        # cold retry repeats the deterministic stall; rung 2 wins
+        assert out.rung == "regularize" and out.attempts == 2
+        assert out.history[0][0] == "cold"
+        sol = out.solution
+        assert np.all(np.isfinite(np.asarray(sol.x)))
+
+    def test_exhaustion_yields_unrecoverable(self):
+        lp = _sick_lp()
+        _, v = _sick_verdict(lp)
+        eng = RemedyEngine(
+            RemedyPolicy(max_attempts=1, allow_f64=False,
+                         allow_lane_switch=False),
+            solver_kw=dict(_SICK_KW), entry="test",
+        )
+        out = eng.remediate(lp, v)  # only the cold rung fits the budget
+        assert not out.recovered and out.rung is None
+        assert out.verdict.verdict == "unrecoverable"
+        assert "ladder exhausted" in out.verdict.detail
+        assert out.attempts == 1
+
+    def test_expired_deadline_keeps_original_verdict(self):
+        lp = _sick_lp()
+        _, v = _sick_verdict(lp)
+        eng = RemedyEngine(
+            solver_kw=dict(_SICK_KW), entry="test", clock=lambda: 100.0,
+        )
+        out = eng.remediate(lp, v, deadline=99.0)
+        assert not out.recovered
+        assert out.verdict is v  # deadline machinery owns the failure
+        assert out.attempts == 0
+
+    def test_as_remedy_coercions(self):
+        assert as_remedy(None) is None
+        eng = RemedyEngine(entry="mine")
+        assert as_remedy(eng) is eng  # engines pass through untouched
+        assert isinstance(as_remedy(True), RemedyEngine)
+        got = as_remedy({"max_attempts": 2, "allow_f64": False},
+                        entry="dicty")
+        assert got.policy.max_attempts == 2 and not got.policy.allow_f64
+        pol = RemedyPolicy(reg_scale=10.0)
+        assert as_remedy(pol).policy.reg_scale == 10.0
+
+    def test_remediate_solution_row_substitutes_recovered(self):
+        lp = _sick_lp()
+        sick, v = _sick_verdict(lp)
+        eng = RemedyEngine(solver_kw=dict(_SICK_KW), entry="test")
+        row, info = eng.remediate_solution_row(
+            lp, sick, budget=_SICK_KW["max_iter"],
+        )
+        assert info["recovered"] and info["verdict"] == "healthy"
+        assert info["original"] == "stalled"
+        assert not _biteq(row.x, sick.x)  # the cured row replaced it
+
+
+# ---------------------------------------------------------------------
+# wiring: solve_lp_adaptive
+# ---------------------------------------------------------------------
+class TestAdaptiveWiring:
+    def test_remedy_off_is_bitwise_identical(self):
+        lp = _sick_lp()
+        ref = solve_lp(lp, **_SICK_KW)
+        got = solve_lp_adaptive(lp, **_SICK_KW)  # remedy defaults to None
+        for a, b in zip(ref, got):
+            assert _biteq(a, b)
+
+    def test_single_problem_remediates(self, tmp_path):
+        reset_metrics()
+        base = _recovered_total()
+        stats = {}
+        path = tmp_path / "remedy.jsonl"
+        tracer = Tracer(str(path))
+        with use_tracer(tracer):
+            sol = solve_lp_adaptive(
+                _sick_lp(), stats=stats, remedy=True, **_SICK_KW
+            )
+            tracer.close()
+        v = obs_health.classify_solution(sol, budget=60)[0]
+        assert v.verdict == "healthy"
+        rem = stats["remediated"][0]
+        assert rem == {
+            "original": "stalled", "verdict": "healthy",
+            "rung": "regularize", "attempts": 2, "recovered": True,
+        }
+        assert _recovered_total() == base + 1
+        evs = [r for r in read_journal(str(path))
+               if r.get("kind") == "event" and r.get("name") == "remediation"]
+        assert len(evs) == 1
+        assert evs[0]["original"] == "stalled" and evs[0]["recovered"]
+        assert evs[0]["rung"] == "regularize"
+
+    def test_batched_bad_lane_substituted_in_place(self):
+        reset_metrics()
+        lps = [_healthy_lp(), _sick_lp(), _healthy_lp()]
+        batch = LPData(*(jnp.stack(a) for a in zip(*lps)))
+        stats = {}
+        sol = solve_lp_adaptive(batch, stats=stats, remedy=True, **_SICK_KW)
+        verdicts = obs_health.classify_solution(sol, budget=60)
+        assert [v.verdict for v in verdicts] == ["healthy"] * 3
+        assert list(stats["remediated"]) == [1]  # only the sick lane ran
+        assert stats["remediated"][1]["rung"] == "regularize"
+        # healthy lanes untouched: bitwise vs the remedy-off batch
+        ref = solve_lp_adaptive(batch, **_SICK_KW)
+        for a, b in zip(ref, sol):
+            assert _biteq(np.asarray(a)[0], np.asarray(b)[0])
+            assert _biteq(np.asarray(a)[2], np.asarray(b)[2])
+
+
+# ---------------------------------------------------------------------
+# wiring: the dispatch service
+# ---------------------------------------------------------------------
+class TestServiceWiring:
+    def test_service_heals_stalled_request(self):
+        reset_metrics()
+        base = _recovered_total()
+        svc = make_dense_service(
+            2, chunk_iters=4, cache_size=None, remedy=True, **_SICK_KW
+        )
+        t_sick = svc.submit(_sick_lp(), request_id="sick")
+        t_ok = svc.submit(_healthy_lp(), request_id="ok")
+        svc.drain()
+        assert t_ok.result(timeout=0).verdict == "healthy"
+        res = t_sick.result(timeout=0)
+        assert res.verdict == "healthy"
+        assert np.all(np.isfinite(np.asarray(res.solution.x)))
+        assert _recovered_total() >= base + 1
+
+    def test_service_remedy_off_still_stalls(self):
+        svc = make_dense_service(
+            2, chunk_iters=4, cache_size=None, **_SICK_KW
+        )
+        t = svc.submit(_sick_lp(), request_id="sick")
+        svc.drain()
+        assert t.result(timeout=0).verdict == "stalled"

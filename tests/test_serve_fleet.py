@@ -329,6 +329,55 @@ class TestFleetFakeClock:
             t.result(0).verdict == "deadline_exceeded" for t in tickets
         )
 
+    def test_poison_quarantined_at_max_requeues(self):
+        clk = FakeClock()
+        fake = FakeShard(0, bucket=2)
+        fleet = self._fleet([fake], clk, max_requeues=1)
+        t = fleet.submit(_lp(0), request_id="poison")
+        fleet.pump()
+        assert fake.inflight() == 1
+        fake.die()
+        fleet.pump()  # first crash: below the cap, requeued
+        assert fleet.requeued_total == 1 and fleet.poisoned_total == 0
+        time.sleep(0.06)
+        fleet.pump()  # respawn + redispatch
+        assert fake.inflight() == 1
+        fake.die()
+        fleet.pump()  # second crash: cap reached, quarantined
+        res = t.result(0)
+        assert res.verdict == "poisoned" and res.solution is None
+        assert res.request_id == "poison"
+        assert fleet.poisoned_total == 1 and fleet.stats()["poisoned"] == 1
+        # the quarantined request never went back: no third requeue
+        assert fleet.requeued_total == 1 and len(fleet.queue) == 0
+        fleet.close()
+
+    def test_non_crash_requeues_stay_off_the_poison_ledger(self):
+        # router-race / dead-pipe requeues decrement the count back —
+        # only crash requeues may burn the quarantine cap
+        clk = FakeClock()
+        fake = FakeShard(0, bucket=2)
+        fleet = self._fleet([fake], clk, max_requeues=1)
+        refuse = {"on": True}
+        orig_solve = fake.solve
+        fake.solve = (
+            lambda lane, req:
+            False if refuse["on"] else orig_solve(lane, req)
+        )
+        t = fleet.submit(_lp(0))
+        for _ in range(4):
+            fleet.pump()  # dead-pipe path: requeue + honesty decrement
+        refuse["on"] = False
+        fleet.pump()
+        assert fake.inflight() == 1
+        req = next(iter(fake.lanes.values()))
+        assert req.requeues == 0  # four refusals burned nothing
+        fake.die()
+        fleet.pump()  # first *crash* still gets its full requeue budget
+        assert fleet.poisoned_total == 0 and len(fleet.queue) == 1
+        assert not t.done()
+        fleet.close()
+
     def test_drain_timeout_sheds_queued(self):
         clk = FakeClock()
         fake = FakeShard(0, bucket=1)
@@ -430,6 +479,75 @@ class TestFleetChildren:
             res = fleet.submit(_lp(301)).result(timeout=240.0)
             assert res.verdict == "nonfinite"
             assert not np.all(np.isfinite(np.asarray(res.solution.x)))
+        finally:
+            fleet.stop(drain=False)
+            fleet.close()
+
+    def test_poison_exit_quarantine_then_bitwise_recovery(self):
+        # one fault="exit" payload kills whichever shard dispatches it;
+        # with max_requeues=1 it gets exactly two kills (shard A, then
+        # the requeue lands on shard B while A is down) before the fleet
+        # quarantines it as `poisoned`. Both shards respawn and the
+        # innocents submitted afterwards still match the single-engine
+        # service bitwise.
+        lps = [_lp(400 + s) for s in range(4)]
+        fleet = _mk_fleet(2, max_requeues=1)
+        try:
+            fleet.start()
+            poison = fleet.submit(
+                _lp(499), request_id="poison", fault="exit"
+            )
+            res = poison.result(timeout=240.0)
+            assert res.verdict == "poisoned" and res.solution is None
+            assert fleet.poisoned_total == 1
+            assert fleet.requeued_total >= 1
+            # both crash domains come back on their own
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 120.0:
+                states = fleet.shard_states()
+                if all(st["state"] == "up" for st in states.values()):
+                    break
+                time.sleep(0.02)
+            states = fleet.shard_states()
+            assert all(st["state"] == "up" for st in states.values())
+            assert sum(st["respawns"] for st in states.values()) >= 2
+            tickets = [fleet.submit(lp) for lp in lps]
+            fleet_res = [t.result(timeout=240.0) for t in tickets]
+            assert fleet.shed_total == 0 and fleet.deadline_total == 0
+        finally:
+            fleet.stop(drain=False)
+            fleet.close()
+        svc = make_dense_service(2, chunk_iters=2, max_iter=40,
+                                 cache_size=None)
+        ref_tickets = [svc.submit(lp) for lp in lps]
+        svc.drain()
+        for got, rt in zip(fleet_res, ref_tickets):
+            ref = rt.result(0)
+            assert got.verdict in ("healthy", "slow")
+            assert got.iterations == ref.iterations
+            for a, b in zip(got.solution, ref.solution):
+                assert _biteq(a, b)
+
+    def test_parent_remediates_unhealthy_child_row(self):
+        # the child solves unregularized and retires "stalled"; the
+        # parent's remediation ladder (remedy=True) re-solves host-side
+        # and the ticket resolves healthy
+        sick = LPData(
+            jnp.asarray([[1.0, 1.0], [1.0, 1.0]], jnp.float64),
+            jnp.asarray([1.0, 1.0], jnp.float64),
+            jnp.asarray([1.0, 2.0], jnp.float64),
+            jnp.zeros(2, jnp.float64), jnp.full(2, 10.0, jnp.float64),
+            jnp.asarray(0.0, jnp.float64),
+        )
+        fleet = _mk_fleet(
+            1, remedy=True,
+            solver_kw=dict(tol=1e-8, max_iter=60, reg_p=0.0, reg_d=0.0),
+        )
+        try:
+            fleet.start()
+            res = fleet.submit(sick, request_id="sick").result(timeout=240.0)
+            assert res.verdict == "healthy"
+            assert np.all(np.isfinite(np.asarray(res.solution.x)))
         finally:
             fleet.stop(drain=False)
             fleet.close()

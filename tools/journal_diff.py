@@ -61,7 +61,7 @@ _HIGHER_IS_BETTER = (
     "per_sec", "per_chip", "converged", "mfu", "tflops", "utilization",
     "throughput", 'verdict="healthy"', "iters_saved", "cache_hit",
     "lanes_retired", "goodput", "terminal/complete", "telemetry_frames",
-    "learned_warm_accept",
+    "learned_warm_accept", "remediation_recovered",
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
@@ -86,6 +86,14 @@ _ZERO_SEEDED = (
     # same-workload DROP (predictor wedged / artifact refused), never on
     # a predictor-enabled run appearing against a cold baseline.
     "learned_warm_accept_total", "learned_warm_reject_total",
+    # self-healing (runtime/remedy.py): ladder attempts and poison
+    # quarantines only exist once a solve went unhealthy or a request
+    # kept killing shards — a clean baseline has no such series. Seeding
+    # makes ladder activity (or a poisoned request) appearing in NEW a
+    # gated regression; recoveries seed too but, as higher-is-better,
+    # only gate on a same-workload DROP (ladder stopped winning).
+    "remediation_attempts_total", "remediation_recovered_total",
+    "poisoned_requests_total",
 )
 
 
@@ -721,6 +729,51 @@ def self_check(out=sys.stdout) -> int:
     })
     checks.append(("rejects within threshold pass",
                    False, any(r["regression"] for r in rows)))
+
+    # self-healing (runtime/remedy.py + serve/fleet.py quarantine):
+    # ladder attempts and poisoned requests are lower-is-better and
+    # zero-seeded (a healthy baseline has no unhealthy solves to
+    # remediate), recoveries are higher-is-better (also zero-seeded, so
+    # they only gate on a same-workload drop — the ladder stopped
+    # winning — never on appearing against a clean baseline)
+    rbase = {
+        'metric/remediation_attempts_total{entry="serve_fleet",rung="cold"}':
+        4.0,
+        'metric/remediation_recovered_total{rung="cold",verdict="stalled"}':
+        4.0,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def rrun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(rbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    rrun("identical remediation counters pass", dict(rbase), False)
+    rrun("ladder attempts tripling fails (lower is better)",
+         {**rbase,
+          'metric/remediation_attempts_total{entry="serve_fleet",rung="cold"}':
+          12.0}, True)
+    rrun("recoveries dropping >10% fails (ladder stopped winning)",
+         {**rbase,
+          'metric/remediation_recovered_total{rung="cold",verdict="stalled"}':
+          2.0}, True)
+    rrun("poisoned requests appearing from zero fail (zero-seeded)",
+         {**rbase, "metric/poisoned_requests_total": 1.0}, True)
+    cleanr = {"serve/loadgen/goodput_rps": 120.0}
+    rows = compare(cleanr, rbase)
+    checks.append((
+        "remediation activity appearing vs clean baseline fails "
+        "(attempts are zero-seeded evidence of unhealthy solves)",
+        True, any(r["regression"] for r in rows)))
+    rows = compare(cleanr, {
+        **cleanr,
+        'metric/remediation_recovered_total{rung="cold",verdict="stalled"}':
+        4.0,
+    })
+    checks.append((
+        "recoveries alone appearing vs clean baseline pass "
+        "(higher-is-better never gates on growth)",
+        False, any(r["regression"] for r in rows)))
 
     ok = True
     for name, want, got in checks:

@@ -34,8 +34,13 @@ with one shard killed mid-run must lose zero requests, respawn the dead
 shard, requeue its in-flight lanes, flip /healthz non-200 while down
 (healing after respawn), keep the fleet-aggregate metrics equal to the
 sum of the per-shard series, and return results bitwise identical to
-the single-engine service at the same bucket. Exit 0 pass / 1 gate
-trip / 2 error.
+the single-engine service at the same bucket. A self-healing leg then
+arms the remediation ladder (`runtime/remedy.py`) on a 2-shard fleet:
+a ``nan``-faulted shard's corrupted result rows must be re-solved
+healthy by the parent-side ladder, and a crafted poison request whose
+dispatch kills its worker must be quarantined as ``poisoned`` after
+``max_requeues`` crash requeues — with zero innocent requests lost and
+every shard respawned. Exit 0 pass / 1 gate trip / 2 error.
 
 The workload is synthetic: small random feasible box LPs with a
 configurable duplicate fraction (`--dup-frac`) so the fingerprint cache
@@ -692,6 +697,170 @@ def _fleet_chaos_pass(out) -> list:
     return failures
 
 
+class _PinRouter:
+    """Deterministic routing for the quarantine leg: poison dispatches
+    (anything carrying a ``fault`` payload) go to shard 0 only, innocents
+    to shard 1 only — a kill then never catches an innocent in flight,
+    so the quarantine accounting is exact rather than probabilistic.
+    (Crash attribution by requeue count is deliberately heuristic: an
+    innocent co-resident with a poison request on every one of its kills
+    would be quarantined too. Pinning removes that coincidence from the
+    gate.)"""
+
+    def __init__(self):
+        from dispatches_tpu.serve.router import Router
+
+        self._base = Router()
+
+    def __getattr__(self, name):  # note_dispatch / forget_shard / ...
+        return getattr(self._base, name)
+
+    def pick(self, req, shards):
+        want = 0 if getattr(req, "fault", None) else 1
+        for s in shards:
+            if s.shard_id == want and s.inflight() < s.bucket:
+                return s
+        return None  # wanted shard down/full: stay queued
+
+
+def _poison_quarantine_pass(out) -> list:
+    """Self-healing acceptance (runtime/remedy.py + fleet quarantine),
+    two sub-legs on 2-shard fleets with the remediation ladder armed.
+    Leg 1: a ``nan``-faulted shard corrupts every result row it returns —
+    the parent-side ladder must re-solve those rows healthy (the cold
+    rung: the problems themselves are fine) so no caller ever sees a
+    nonfinite answer. Leg 2: a crafted poison request (``fault="exit"``
+    kills whichever worker dispatches it) must be quarantined as
+    ``poisoned`` once it exhausts ``max_requeues`` crash requeues, while
+    every innocent request resolves healthy and the fleet ends the leg
+    fully respawned."""
+    from dispatches_tpu.obs import metrics as obs_metrics
+    from dispatches_tpu.serve import make_dense_fleet
+
+    failures = []
+    bucket = 4
+
+    def _recovered_total() -> float:
+        counters = obs_metrics.snapshot()["counters"]
+        return sum(
+            v for k, v in counters.items()
+            if k.startswith("remediation_recovered_total")
+        )
+
+    # -- leg 1: nan-faulted shard, ladder re-solves on the parent ------
+    fleet = make_dense_fleet(
+        2, bucket, chunk_iters=4, cache_size=None,
+        solver_kw={"max_iter": 60}, heartbeat_every=0.1, remedy=True,
+    )
+    try:
+        fleet.inject_fault(0, "nan")
+        # give the fault op time to land before dispatches follow it
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 0.3:
+            fleet.pump()
+        rec0 = _recovered_total()
+        nan_seeds = list(range(9000, 9012))
+        nan_tix = {
+            s: fleet.submit(make_problem(s), priority="batch",
+                            request_id=f"nan{s}")
+            for s in nan_seeds
+        }
+        fleet.drain(timeout=300.0)
+        bad = [
+            s for s, t in nan_tix.items()
+            if not t.done() or t.result(0).verdict not in ("healthy", "slow")
+        ]
+        if bad:
+            failures.append(
+                f"poison leg: {len(bad)} requests through the nan-faulted "
+                f"fleet not healthy (remediation should have cured them)"
+            )
+        recovered = _recovered_total() - rec0
+        if recovered < 1:
+            failures.append(
+                "poison leg: nan-corrupted rows produced no "
+                "remediation_recovered_total increments"
+            )
+        else:
+            print(
+                f"poison leg: {recovered:.0f} nan-corrupted rows "
+                "remediated healthy by the parent ladder", file=out,
+            )
+    finally:
+        fleet.close()
+
+    # -- leg 2: poison request + innocent bystanders -------------------
+    fleet = make_dense_fleet(
+        2, bucket, chunk_iters=4, cache_size=None,
+        solver_kw={"max_iter": 60}, heartbeat_every=0.1,
+        max_requeues=1, remedy=True, router=_PinRouter(),
+    )
+    try:
+        innocents = {
+            s: fleet.submit(make_problem(s), priority="batch",
+                            request_id=f"innocent{s}")
+            for s in range(9100, 9112)
+        }
+        poison = fleet.submit(
+            make_problem(9999), priority="batch", request_id="poison",
+            fault="exit",
+        )
+        fleet.drain(timeout=300.0)
+        if not poison.done():
+            failures.append("poison leg: poison ticket never resolved")
+        elif poison.result(0).verdict != "poisoned":
+            failures.append(
+                "poison leg: poison request resolved "
+                f"{poison.result(0).verdict!r}, wanted 'poisoned'"
+            )
+        else:
+            print(
+                "poison leg: poison request quarantined after "
+                f"{poison.request.requeues} crash requeues", file=out,
+            )
+        lost = [s for s, t in innocents.items() if not t.done()]
+        unhealthy = [
+            s for s, t in innocents.items()
+            if t.done() and t.result(0).verdict not in ("healthy", "slow")
+        ]
+        if lost:
+            failures.append(f"poison leg: {len(lost)} innocents lost")
+        if unhealthy:
+            failures.append(
+                f"poison leg: {len(unhealthy)} innocents unhealthy "
+                f"(first: {[(s, innocents[s].result(0).verdict) for s in unhealthy[:3]]})"
+            )
+        st = fleet.stats()
+        if st["poisoned"] != 1:
+            failures.append(
+                f"poison leg: stats poisoned={st['poisoned']}, wanted 1"
+            )
+        # shard 0 must come back up: the quarantine capped the blast
+        # radius at max_requeues+1 kills, and respawn healed each one
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0:
+            fleet.pump()
+            if all(
+                s["state"] == "up" for s in fleet.shard_states().values()
+            ):
+                break
+            time.sleep(0.05)
+        states = fleet.shard_states()
+        down = [k for k, s in states.items() if s["state"] != "up"]
+        if down:
+            failures.append(
+                f"poison leg: shards {down} still down after quarantine"
+            )
+        else:
+            print(
+                "poison leg: fleet fully up after quarantine "
+                f"(respawns={fleet.stats()['respawns']})", file=out,
+            )
+    finally:
+        fleet.close()
+    return failures
+
+
 def _check_journeys(journal, latencies, out) -> list:
     """Acceptance checks on the self-check journal's journey records:
     every terminal request has a complete journey whose phase durations
@@ -856,6 +1025,7 @@ def self_check(out=sys.stdout) -> int:
         latencies = report.pop("latencies_by_id")
         latencies.update(_terminal_mini_pass(out))
         chaos_failures = _fleet_chaos_pass(out)
+        chaos_failures += _poison_quarantine_pass(out)
         chaos_failures += _warm_model_pass(out)
         tr.event("loadgen_report", **{
             k: v for k, v in report.items() if isinstance(v, (int, float))

@@ -81,8 +81,8 @@ def _split_runs(events: List[dict]) -> List[List[dict]]:
 # summarizing a journal never needs to import jax-adjacent packages)
 _SEVERITY = (
     "healthy", "slow", "cycling", "stalled",
-    "deadline_exceeded", "shed",
-    "diverged", "nonfinite", "hang", "failed",
+    "deadline_exceeded", "shed", "shed_tenant_quota", "poisoned",
+    "diverged", "nonfinite", "unrecoverable", "hang", "failed",
 )
 
 
@@ -244,6 +244,18 @@ def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
     if ev.get("warm_source"):
         verdict = "accept" if ev.get("warm_accepted") else "reject"
         line += f" warm={ev['warm_source']}/{verdict}"
+    # self-healing attribution (runtime/remedy.py): the serve tier
+    # attaches the ladder's outcome for a remediated request. Journals
+    # predating the field render exactly as before.
+    rem = ev.get("remediation")
+    if isinstance(rem, dict):
+        if rem.get("recovered"):
+            line += f" remedied={rem.get('original')}->{rem.get('rung')}"
+        else:
+            line += (
+                f" remedy=exhausted({rem.get('original')},"
+                f"{rem.get('attempts')} attempts)"
+            )
     ad = ev.get("adaptive_stats")
     if isinstance(ad, dict):
         line += (
@@ -252,6 +264,12 @@ def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
             f" compile {ad.get('compile_hits')}h/"
             f"{ad.get('compile_misses')}m]"
         )
+        if isinstance(ad.get("remediated"), dict) and ad["remediated"]:
+            n_rec = sum(
+                1 for v in ad["remediated"].values()
+                if isinstance(v, dict) and v.get("recovered")
+            )
+            line += f" remedied={n_rec}/{len(ad['remediated'])}"
     elif ev.get("adaptive"):
         line += " adaptive"
     # serve-layer columns (dispatches_tpu/serve): per-request solves
