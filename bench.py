@@ -1444,6 +1444,96 @@ def main():
     _flush_local()
     _journal().event("row", row="remediation", **rm)
 
+    # Alerting chaos row (obs/timeseries.py + obs/alerts.py): a 2-shard
+    # fleet with the retention/alerting plane on, one shard SIGKILLed
+    # mid-stream — the shard_down page must fire while the shard is down
+    # and resolve after the respawn, with the queue-depth history
+    # retained for /query. Records the fire/resolve latencies so the
+    # BENCH trajectory catches an alerting plane that goes slow or mute.
+    def _alerting_row():
+        from dispatches_tpu.serve import make_dense_fleet
+
+        fleet = make_dense_fleet(
+            2, 2, chunk_iters=4, cache_size=None,
+            solver_kw={"max_iter": 60}, timeseries=True,
+        )
+        fired_s = resolved_s = None
+        try:
+            tickets = [
+                fleet.submit(_loadgen.make_problem(s), priority="batch",
+                             request_id=f"alert{s}")
+                for s in range(8200, 8208)
+            ]
+            victim, t0 = None, time.monotonic()
+            while victim is None and time.monotonic() - t0 < 60.0:
+                fleet.pump()
+                busy = [
+                    k for k, st in fleet.shard_states().items()
+                    if st["state"] == "up" and st["inflight"] > 0
+                ]
+                if busy:
+                    victim = busy[0]
+            kill_t = time.monotonic()
+            if victim is not None:
+                fleet.kill_shard(victim)
+            while fired_s is None and time.monotonic() - kill_t < 30.0:
+                fleet.pump()
+                if any(f["rule"] == "shard_down"
+                       for f in fleet.alerts.firing()):
+                    fired_s = time.monotonic() - kill_t
+            fleet.drain(timeout=300.0)
+            t0 = time.monotonic()
+            while resolved_s is None and time.monotonic() - t0 < 30.0:
+                fleet.pump()
+                if not any(f["rule"] == "shard_down"
+                           for f in fleet.alerts.firing()):
+                    resolved_s = time.monotonic() - kill_t
+                else:
+                    time.sleep(0.02)
+            results = [t.result(timeout=60.0) for t in tickets]
+            unhealthy = sum(
+                1 for r in results if r.verdict not in ("healthy", "slow")
+            )
+            phases = [
+                h["phase"] for h in fleet.alerts.report()["history"]
+                if h["rule"] == "shard_down"
+            ]
+            qd_points = sum(
+                len(s["t"])
+                for s in fleet.store.query("serve_queue_depth", window=300.0)
+            )
+            st = fleet.stats()
+            return {
+                "victim": victim,
+                "fired_after_s": (
+                    round(fired_s, 3) if fired_s is not None else None
+                ),
+                "resolved_after_s": (
+                    round(resolved_s, 3) if resolved_s is not None else None
+                ),
+                "lifecycle": phases,
+                "queue_depth_points": qd_points,
+                "respawns": st["respawns"],
+                "unhealthy": unhealthy,
+                "gate_ok": (
+                    victim is not None
+                    and fired_s is not None
+                    and resolved_s is not None
+                    and phases[:2] == ["firing", "resolved"]
+                    and unhealthy == 0
+                    and qd_points > 0
+                ),
+            }
+        finally:
+            fleet.close()
+
+    al = _device("alerting chaos", _alerting_row)
+    _LOCAL["rows"]["alerting"] = al
+    _DIAG.setdefault("serve", {})["alerting"] = dict(al)
+    _atomic_dump(_DIAG, _DIAG_PATH)
+    _flush_local()
+    _journal().event("row", row="alerting", **al)
+
     result = {
         "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
         f"(T=168h, batch={B}, converged={conv_frac:.3f}, "

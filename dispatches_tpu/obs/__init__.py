@@ -34,6 +34,15 @@ Three pillars (see docs/observability.md):
    fleet aggregate equal to the sum of per-shard series by
    construction, and `TelemetryExporter` serves the merged view over
    ``/metrics`` + ``/healthz`` + ``/slo``.
+10. **Time series, alerts & control signals** (`obs.timeseries`,
+   `obs.alerts`, `obs.signals`): fixed-memory multi-resolution ring
+   buffers sampled from the registry (counters as values with rates
+   derived on query, histograms as retained quantile tracks),
+   declarative alert rules (threshold / rate / absence / SLO-burn with
+   hold durations and hysteresis) whose firing→resolved lifecycle is
+   journaled and metered, and EWMA-smoothed `Signal.value()/trend()`
+   control signals for the future autoscaler — served over the
+   exporter's ``/query`` + ``/alerts``.
 """
 from .cost import (  # noqa: F401
     chip_peak_tflops,
@@ -66,6 +75,12 @@ from .journal import (  # noqa: F401
     set_tracer,
     use_tracer,
 )
+from .alerts import (  # noqa: F401
+    AlertManager,
+    AlertRule,
+    default_fleet_rules,
+    rule_from_dict,
+)
 from .exporter import TelemetryExporter, start_exporter  # noqa: F401
 from .memory import device_memory_stats, memory_watermark_bytes  # noqa: F401
 from .metrics import (  # noqa: F401
@@ -82,6 +97,7 @@ from .metrics import (  # noqa: F401
     set_gauge,
     snapshot,
     snapshot_delta,
+    sum_gauges,
 )
 from .profile import (  # noqa: F401
     annotation,
@@ -112,12 +128,18 @@ from .retrace import (  # noqa: F401
     signature_of,
     total_retraces,
 )
+from .signals import ControlSignals, Signal  # noqa: F401
 from .slo import (  # noqa: F401
     SLO,
     breaches,
     burn_rates,
     evaluate_slos,
     worst_burn_rate,
+)
+from .timeseries import (  # noqa: F401
+    Sampler,
+    SeriesStore,
+    snapshot_quantile,
 )
 from .trace import (  # noqa: F401
     SolveTrace,
@@ -206,4 +228,14 @@ __all__ = [
     "evaluate_slos",
     "worst_burn_rate",
     "breaches",
+    "SeriesStore",
+    "Sampler",
+    "snapshot_quantile",
+    "AlertRule",
+    "AlertManager",
+    "default_fleet_rules",
+    "rule_from_dict",
+    "Signal",
+    "ControlSignals",
+    "sum_gauges",
 ]

@@ -16,6 +16,13 @@ three endpoints an operator actually points things at:
   records: per-priority burn rates, worst burn, breaches.
 - ``/snapshot`` — the registry's JSON `snapshot()` (the machine-friendly
   twin of ``/metrics``; `tools/fleet_top.py` live mode reads this).
+- ``/query``    — retained time series from an attached
+  `obs.timeseries.SeriesStore` (``?name=...&window=...&agg=raw|rate|
+  delta&<label>=<value>``): JSON aligned (t, v) arrays per matching
+  series. 404 until a store is attached (``store=``), so point-in-time
+  deployments cost nothing.
+- ``/alerts``   — the attached `obs.alerts.AlertManager.report()`:
+  firing instances, recent firing→resolved transitions, the rule pack.
 
 Design rules, same as the rest of `obs`: stdlib only, off by default
 (nothing starts a server unless a tool passes ``--exporter-port``),
@@ -53,6 +60,8 @@ class TelemetryExporter:
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         slo_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         slos: Optional[Sequence[Any]] = None,
+        store: Optional[Any] = None,
+        alerts: Optional[Any] = None,
     ):
         self.host = str(host)
         self.port = int(port)
@@ -60,6 +69,8 @@ class TelemetryExporter:
         self.health_fn = health_fn
         self.slo_fn = slo_fn
         self.slos = slos
+        self.store = store  # obs.timeseries.SeriesStore, serves /query
+        self.alerts = alerts  # obs.alerts.AlertManager, serves /alerts
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -91,10 +102,35 @@ class TelemetryExporter:
             ],
         }
 
+    def _query(self, qs: str):
+        """``/query``: name (required), window (seconds, default 300),
+        agg (raw|rate|delta); any other parameter is a label match."""
+        from urllib.parse import parse_qsl
+
+        if self.store is None:
+            return 404, "text/plain; charset=utf-8", b"no series store attached\n"
+        params = dict(parse_qsl(qs, keep_blank_values=True))
+        name = params.pop("name", None)
+        if not name:
+            return (
+                400, "application/json",
+                _json_bytes({"error": "missing required parameter: name"}),
+            )
+        window = float(params.pop("window", 300.0))
+        agg = params.pop("agg", "raw")
+        series = self.store.query(name, params or None, window=window, agg=agg)
+        return 200, "application/json", _json_bytes({
+            "name": name,
+            "labels": params,
+            "window": window,
+            "agg": agg,
+            "series": series,
+        })
+
     def handle_path(self, path: str):
         """Route one GET: returns (status, content_type, body_bytes).
         Exposed for tests that don't want a real socket."""
-        path = path.split("?", 1)[0]
+        path, _, qs = path.partition("?")
         try:
             if path == "/metrics":
                 body = self._registry().render_prometheus()
@@ -107,6 +143,12 @@ class TelemetryExporter:
                 return 200, "application/json", _json_bytes(self._slo())
             if path == "/snapshot":
                 return 200, "application/json", _json_bytes(self._registry().snapshot())
+            if path == "/query":
+                return self._query(qs)
+            if path == "/alerts":
+                if self.alerts is None:
+                    return 404, "text/plain; charset=utf-8", b"no alert manager attached\n"
+                return 200, "application/json", _json_bytes(self.alerts.report())
             return 404, "text/plain; charset=utf-8", b"not found\n"
         except Exception as e:  # a broken callback must not kill the server
             return (

@@ -229,8 +229,13 @@ class MetricsRegistry:
           long as the sender ships deltas, which `snapshot_delta`
           guarantees).
         - **Gauges are absolute**, last-write-wins, and get only the
-          re-labeled series: a sum of per-shard gauges is meaningless, so
-          no aggregate series is written.
+          re-labeled series — a label-free fleet aggregate is NEVER
+          written for a gauge, because adding absolute levels from
+          different instants is meaningless as a single level. Callers
+          that do want "sum of per-shard gauges right now" (fleet
+          in-flight lanes, say) must ask for it explicitly via
+          `sum_gauges`, which sums the *current* labeled series under
+          one lock instead of baking a stale sum into the registry.
         - **Histogram buckets merge bucket-wise** when the bucket ladder
           matches (the common case — both sides use the same describe
           site); mismatched ladders re-bucket each incoming count at its
@@ -322,6 +327,23 @@ class MetricsRegistry:
                     frac = (rank - prev) / h.counts[i] if h.counts[i] else 0.0
                     return lo + (b - lo) * frac
             return h.buckets[-1] if h.buckets else None
+
+    def sum_gauges(self, name: str, **labels: Any) -> Optional[float]:
+        """Sum every gauge series named `name` whose labels are a
+        superset of `labels` — the explicit cross-shard aggregation for
+        gauges, which `merge` deliberately never materializes (see its
+        docstring). Returns None when nothing matches, so "no shards
+        reporting" stays distinguishable from "zero in flight"."""
+        want = {str(k): str(v) for k, v in labels.items()}
+        total: Optional[float] = None
+        with self._lock:
+            for (n, ls), v in self._gauges.items():
+                if n != name:
+                    continue
+                have = dict(ls)
+                if all(have.get(k) == s for k, s in want.items()):
+                    total = (total or 0.0) + v
+        return total
 
     def flat_values(self) -> Dict[str, float]:
         """Monotone series as one flat {series: value} dict — counters plus
@@ -479,6 +501,10 @@ def describe(name: str, text: str) -> None:
 
 def histogram_quantile(name: str, q: float, **labels: Any) -> Optional[float]:
     return _REGISTRY.histogram_quantile(name, q, **labels)
+
+
+def sum_gauges(name: str, **labels: Any) -> Optional[float]:
+    return _REGISTRY.sum_gauges(name, **labels)
 
 
 def snapshot() -> Dict[str, Dict[str, Any]]:

@@ -150,6 +150,10 @@ class FleetService:
         spawn: bool = True,
         max_requeues: int = 2,
         remedy=None,
+        timeseries: bool = False,
+        store=None,
+        alert_rules=None,
+        slo_fn=None,
     ):
         if not shards:
             raise ValueError("a fleet needs at least one shard")
@@ -184,6 +188,36 @@ class FleetService:
             remedy, solver_kw=ref.solver_kw, entry="serve_fleet",
             clock=clock,
         )
+        # time-series retention + alerting plane (docs/observability.md
+        # §10; off by default and bitwise-neutral for solve results):
+        # pump() samples the store on the service clock and evaluates the
+        # rule pack after every fresh sample. Shard down/respawn force an
+        # immediate sample so the lifecycle is captured even when it fits
+        # between two cadence samples (a 0.25 s backoff vs a 1 s tier).
+        self.store = store
+        self.alerts = None
+        if timeseries and self.store is None:
+            from ..obs.timeseries import SeriesStore
+
+            self.store = SeriesStore(clock=clock)
+        if self.store is not None:
+            from ..obs.alerts import AlertManager, default_fleet_rules
+
+            rules = (
+                default_fleet_rules(
+                    queue_limit=queue_limit,
+                    heartbeat_timeout=self.heartbeat_timeout,
+                )
+                if alert_rules is None
+                else list(alert_rules)
+            )
+            self.alerts = AlertManager(
+                self.store, rules, clock=clock, slo_fn=slo_fn
+            )
+            # zero-seed so the first poison produces a computable rate
+            # (a counter born at 1 has no baseline inside the window)
+            self.store._registry().inc("poisoned_requests_total", 0)
+        self._ts_force = False
         self._lock = threading.RLock()
         self._seq = 0
         self._thread: Optional[threading.Thread] = None
@@ -311,6 +345,15 @@ class FleetService:
                         max(0.0, mono - slot.shard.last_pong),
                         shard=str(slot.shard.shard_id),
                     )
+            if self.store is not None:
+                t = self.clock()
+                sampled = (
+                    self.store.sample(t) if self._ts_force
+                    else self.store.maybe_sample(t)
+                )
+                self._ts_force = False
+                if sampled and self.alerts is not None:
+                    self.alerts.evaluate(t)
         return done
 
     def _harvest(self) -> int:
@@ -440,6 +483,7 @@ class FleetService:
             poisoned_lanes=len(inflight) - n,
             respawn_in_s=round(slot.respawn_at - time.monotonic(), 3),
         )
+        self._ts_force = True  # the down gauge must reach the store now
 
     def _spawn_slot(self, slot: _ShardSlot) -> bool:
         try:
@@ -479,6 +523,7 @@ class FleetService:
                         "shard_respawn", shard=slot.shard.shard_id,
                         respawn=slot.respawns, backoff_s=backoff_was,
                     )
+                    self._ts_force = True  # capture the up flip promptly
 
     def _dispatch(self, now: float) -> None:
         up = [s.shard for s in self._slots if s.state == "up"]
@@ -943,6 +988,10 @@ class FleetService:
             }
             if self.cache is not None:
                 out["cache"] = self.cache.stats()
+            if self.store is not None:
+                out["timeseries"] = self.store.stats()
+            if self.alerts is not None:
+                out["alerts_firing"] = self.alerts.firing()
             for status in ("ok", "cached"):
                 for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
                     v = obs_metrics.histogram_quantile(
@@ -964,6 +1013,7 @@ def make_dense_fleet(
     clock=time.monotonic,
     reqtrace: bool = False,
     telemetry: bool = False,
+    timeseries: bool = False,
     stderr_dir: Optional[str] = None,
     spawn: bool = True,
     warm_model: Optional[str] = None,
@@ -980,8 +1030,11 @@ def make_dense_fleet(
     ``telemetry=True`` spawns children with ``--telemetry`` (metrics +
     journal deltas ride the heartbeat back into the parent registry);
     ``reqtrace=True`` additionally makes children attach chunk-loop
-    journey marks to result frames. Both off by default and
-    bitwise-neutral for solve results. `warm_model` (an artifact path
+    journey marks to result frames; ``timeseries=True`` attaches an
+    `obs.timeseries.SeriesStore` + the `obs.alerts.default_fleet_rules`
+    pack, sampled/evaluated from ``pump()`` (``fleet.store.query(...)``,
+    ``fleet.alerts.firing()``, the exporter's ``/query`` + ``/alerts``).
+    All off by default and bitwise-neutral for solve results. `warm_model` (an artifact path
     from tools/train_warmstart.py; default None = today's cold path)
     makes every child seed cold dispatches through the solver's
     safeguarded learned warm-start plumbing."""
@@ -1011,5 +1064,6 @@ def make_dense_fleet(
     cache = ResultCache(cache_size) if cache_size else None
     return FleetService(
         shards, queue_limit=queue_limit, tenants=tenants, cache=cache,
-        clock=clock, reqtrace=reqtrace, spawn=spawn, **fleet_kw,
+        clock=clock, reqtrace=reqtrace, spawn=spawn,
+        timeseries=timeseries, **fleet_kw,
     )

@@ -92,6 +92,7 @@ class DispatchService:
         name: str = "serve",
         reqtrace: bool = False,
         mem_sample_every: int = 32,
+        store=None,
     ):
         self.engine = engine
         self.queue = AdmissionQueue(queue_limit)
@@ -104,6 +105,11 @@ class DispatchService:
             # sharing the service clock; None keeps the hot path untouched
             engine.observer = obs_reqtrace.EngineJourneyObserver(clock)
         self.mem_sample_every = int(mem_sample_every)
+        # obs.timeseries.SeriesStore (None = retention off, the default):
+        # pump() calls maybe_sample on the service clock, so ring-buffer
+        # history accrues at the store's raw resolution with zero effect
+        # on solve results — the sampler only reads registry floats
+        self.store = store
         self._pump_count = 0
         self._lock = threading.RLock()
         self._seq = 0
@@ -248,6 +254,8 @@ class DispatchService:
             obs_metrics.set_gauge(
                 "serve_active_lanes", len(self.engine.active())
             )
+            if self.store is not None:
+                self.store.maybe_sample(self.clock())
         return done
 
     def drain(
@@ -490,6 +498,8 @@ class DispatchService:
             }
             if self.cache is not None:
                 out["cache"] = self.cache.stats()
+            if self.store is not None:
+                out["timeseries"] = self.store.stats()
             for status in ("ok", "cached"):
                 for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
                     v = obs_metrics.histogram_quantile(
@@ -509,6 +519,7 @@ def make_dense_service(
     clock=time.monotonic,
     trace: bool = False,
     reqtrace: bool = False,
+    timeseries: bool = False,
     warm_model=None,
     remedy=None,
     **solver_kw,
@@ -527,7 +538,12 @@ def make_dense_service(
     default None = untouched harvest, bitwise-identical) re-solves lanes
     that retire unhealthy up the escalation ladder, bounded by the
     request's remaining deadline on the service clock
-    (docs/serving.md "Self-healing & quarantine")."""
+    (docs/serving.md "Self-healing & quarantine").
+
+    `timeseries=True` (default False = no retention, bitwise-identical)
+    attaches an `obs.timeseries.SeriesStore` on the service clock and
+    samples it from `pump()`, so ``service.store.query(...)`` answers
+    over history (docs/observability.md §10)."""
     from ..runtime.adaptive import make_dense_engine
 
     remedy_engine = None
@@ -544,7 +560,12 @@ def make_dense_service(
         warm_predictor=warm_model, remedy=remedy_engine, **solver_kw
     )
     cache = ResultCache(cache_size) if cache_size else None
+    store = None
+    if timeseries:
+        from ..obs.timeseries import SeriesStore
+
+        store = SeriesStore(clock=clock)
     return DispatchService(
         engine, queue_limit=queue_limit, cache=cache, clock=clock,
-        reqtrace=reqtrace,
+        reqtrace=reqtrace, store=store,
     )

@@ -1,0 +1,903 @@
+"""Observability pillar 10: time-series retention (`obs.timeseries`),
+declarative alerting (`obs.alerts`), control signals (`obs.signals`),
+the exporter's ``/query`` + ``/alerts`` routes, and the serving tier's
+``timeseries=True`` wiring. Everything runs on injectable clocks and
+private registries except two deliberately-real tests: the concurrent
+scrape hammer (child shards + thread storm) and the bitwise-neutrality
+check (in-process engine) — each pays a jax compile, so they stay small.
+"""
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.obs import metrics as obs_metrics
+from dispatches_tpu.obs.alerts import (
+    AlertManager,
+    AlertRule,
+    default_fleet_rules,
+    rule_from_dict,
+)
+from dispatches_tpu.obs.exporter import TelemetryExporter
+from dispatches_tpu.obs.journal import Tracer, use_tracer
+from dispatches_tpu.obs.metrics import MetricsRegistry, reset_metrics
+from dispatches_tpu.obs.signals import ControlSignals, Signal
+from dispatches_tpu.obs.timeseries import (
+    Sampler,
+    SeriesStore,
+    snapshot_quantile,
+)
+from dispatches_tpu.serve import FleetService, make_dense_service
+
+
+def _lp(seed, n=6, m=3, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    return LPData(
+        jnp.asarray(A, dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+class Clk:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _store(tiers=((1.0, 64),), **kw):
+    reg = MetricsRegistry()
+    clk = Clk()
+    return reg, clk, SeriesStore(reg, tiers=tiers, clock=clk, **kw)
+
+
+# ---------------------------------------------------------------------
+# snapshot_quantile: the sample-time bucket-ladder → quantile path
+# ---------------------------------------------------------------------
+class TestSnapshotQuantile:
+    def test_empty_and_all_zero_are_none(self):
+        assert snapshot_quantile({}, 0.95) is None
+        assert snapshot_quantile(
+            {"count": 0, "sum": 0.0, "buckets": {}}, 0.95
+        ) is None
+        # count > 0 but an all-zero ladder is still "no data", not p95=0
+        h = {"count": 4, "sum": 1.0, "buckets": {"1.0": 0, "+Inf": 0}}
+        assert snapshot_quantile(h, 0.95) is None
+
+    def test_linear_interpolation_within_bucket(self):
+        h = {"count": 10, "sum": 5.0, "buckets": {"1.0": 10, "+Inf": 0}}
+        assert snapshot_quantile(h, 0.5) == pytest.approx(0.5)
+        assert snapshot_quantile(h, 1.0) == pytest.approx(1.0)
+
+    def test_inf_tail_clamps_to_largest_finite_bound(self):
+        h = {"count": 2, "sum": 9.0, "buckets": {"1.0": 1, "+Inf": 1}}
+        assert snapshot_quantile(h, 0.99) == pytest.approx(1.0)
+
+    def test_tracks_registry_histograms(self):
+        reg = MetricsRegistry()
+        for v in (0.1, 0.2, 0.3, 0.9):
+            reg.observe("lat", v, buckets=(0.25, 0.5, 1.0))
+        h = reg.snapshot()["histograms"]["lat"]
+        got = snapshot_quantile(h, 0.95)
+        want = reg.histogram_quantile("lat", 0.95)
+        assert got == pytest.approx(want)
+
+
+# ---------------------------------------------------------------------
+# SeriesStore: sampling, retention tiers, queries, reductions
+# ---------------------------------------------------------------------
+class TestSeriesStore:
+    def test_samples_counters_gauges_and_quantile_tracks(self):
+        reg, clk, store = _store()
+        reg.inc("jobs_total", 3.0)
+        reg.set_gauge("depth", 2.0, shard="0")
+        reg.observe("lat", 0.5, buckets=(1.0,))
+        wrote = store.sample(1.0)
+        # jobs_total, depth{shard}, lat_count, lat_sum, lat_{p50,p95,p99}
+        assert wrote == 7
+        (q,) = store.query("jobs_total", window=10.0, now=1.0)
+        assert q["kind"] == "counter" and q["v"] == [3.0]
+        (q,) = store.query("depth", window=10.0, now=1.0)
+        assert q["series"] == 'depth{shard="0"}' and q["v"] == [2.0]
+        (q,) = store.query("lat_p95", window=10.0, now=1.0)
+        assert q["kind"] == "gauge" and 0.0 < q["v"][0] <= 1.0
+
+    def test_mixed_empty_histograms_skip_quantile_tracks(self):
+        # the satellite fixture: one populated histogram next to an
+        # empty one and an all-zero ladder — quantile tracks exist only
+        # for the populated series, so /query (and the renderers' em
+        # dash) distinguish "no data" from "p95 = 0"
+        class _FixtureReg(MetricsRegistry):
+            def snapshot(self):
+                return {
+                    "counters": {},
+                    "gauges": {},
+                    "histograms": {
+                        'lat{shard="0"}': {
+                            "count": 2, "sum": 0.6,
+                            "buckets": {"1.0": 2, "+Inf": 0},
+                        },
+                        'lat{shard="1"}': {
+                            "count": 0, "sum": 0.0,
+                            "buckets": {"1.0": 0, "+Inf": 0},
+                        },
+                        'lat{shard="2"}': {
+                            "count": 3, "sum": 0.0,
+                            "buckets": {"1.0": 0, "+Inf": 0},
+                        },
+                    },
+                }
+
+        clk = Clk()
+        store = SeriesStore(_FixtureReg(), tiers=((1.0, 8),), clock=clk)
+        store.sample(1.0)
+        names = store.series()
+        assert 'lat_p95{shard="0"}' in names
+        assert not any("lat_p95" in s and 'shard="1"' in s for s in names)
+        assert not any("lat_p95" in s and 'shard="2"' in s for s in names)
+        # count/sum tracks exist for all three: the traffic history
+        # stays queryable even when the quantile is undefined
+        for shard in ("0", "1", "2"):
+            assert f'lat_count{{shard="{shard}"}}' in names
+
+    def test_ring_wraparound_keeps_newest(self):
+        reg, clk, store = _store(tiers=((1.0, 4),))
+        for t in range(6):
+            reg.set_gauge("g", float(t))
+            store.sample(float(t))
+        (q,) = store.query("g", window=100.0, now=5.0)
+        assert q["t"] == [2.0, 3.0, 4.0, 5.0]
+        assert q["v"] == [2.0, 3.0, 4.0, 5.0]
+
+    def test_maybe_sample_cadence(self):
+        reg, clk, store = _store(tiers=((1.0, 8),))
+        reg.set_gauge("g", 1.0)
+        assert store.maybe_sample(0.0) is True
+        assert store.maybe_sample(0.5) is False
+        assert store.maybe_sample(1.0) is True
+        assert store.stats()["samples"] == 2
+
+    def test_downsample_boundary_stamps_and_aggregates(self):
+        reg, clk, store = _store(tiers=((1.0, 16), (4.0, 8)))
+        for t in range(9):
+            reg.set_gauge("g", float(t))
+            reg.inc("c", 2.0)  # cumulative 2, 4, ..., 18
+            store.sample(float(t))
+        # window too wide for the raw tier (span 16) → coarse tier
+        (qg,) = store.query("g", window=20.0, now=8.0)
+        assert qg["t"] == [4.0, 8.0]  # (bucket + 1) * resolution
+        assert qg["v"] == [1.5, 5.5]  # gauges fold to the bucket mean
+        (qc,) = store.query("c", window=20.0, now=8.0)
+        assert qc["v"] == [8.0, 16.0]  # counters to the last cumulative
+
+    def test_coarse_tier_falls_back_to_raw_when_young(self):
+        reg, clk, store = _store(tiers=((1.0, 4), (60.0, 10)))
+        reg.set_gauge("g", 7.0)
+        store.sample(0.0)
+        store.sample(1.0)
+        # window 30 > raw span 4 → tier 1, which has no completed
+        # bucket yet: young stores still answer from the raw ring
+        (q,) = store.query("g", window=30.0, now=1.0)
+        assert q["v"] == [7.0, 7.0]
+
+    def test_rate_clamps_counter_resets(self):
+        reg, clk, store = _store()
+        for t, v in enumerate([0.0, 5.0, 3.0, 9.0]):  # 3.0 = reset
+            reg._counters.clear()
+            reg.inc("c", v)
+            store.sample(float(t))
+        (q,) = store.query("c", window=10.0, now=3.0, agg="rate")
+        assert q["t"] == [1.0, 2.0, 3.0]
+        assert q["v"] == [5.0, 0.0, 6.0]  # reset reads as silence
+        (q,) = store.query("c", window=10.0, now=3.0, agg="delta")
+        assert q["v"] == [5.0, 0.0, 6.0]
+        with pytest.raises(ValueError):
+            store.query("c", agg="bogus")
+
+    def test_label_superset_match(self):
+        reg, clk, store = _store()
+        reg.set_gauge("g", 1.0, shard="0", tenant="a")
+        reg.set_gauge("g", 2.0, shard="1", tenant="a")
+        store.sample(0.0)
+        assert len(store.query("g", window=10.0, now=0.0)) == 2
+        (q,) = store.query("g", {"shard": "0"}, window=10.0, now=0.0)
+        assert q["v"] == [1.0]
+        assert store.query("g", {"shard": "9"}, window=10.0, now=0.0) == []
+
+    def test_reduce_aggs(self):
+        reg, clk, store = _store()
+        for t, v in enumerate([1.0, 3.0, 2.0]):
+            reg.set_gauge("g", v)
+            store.sample(float(t))
+        r = lambda agg, **kw: store.reduce("g", window=10.0, agg=agg,
+                                           now=2.0, **kw)
+        assert r("last") == 2.0
+        assert r("avg") == pytest.approx(2.0)
+        assert r("min") == 1.0
+        assert r("max") == 3.0
+        assert r("sum") == 6.0
+        assert store.reduce("nope", now=2.0) is None
+        with pytest.raises(ValueError):
+            r("bogus")
+
+    def test_reduce_rate_and_multi_series_sum(self):
+        reg, clk, store = _store()
+        for t in range(4):
+            reg._counters.clear()
+            reg.inc("c", float(2 * t))
+            reg.set_gauge("g", 1.0, shard="0")
+            reg.set_gauge("g", 2.0, shard="1")
+            store.sample(float(t))
+        assert store.reduce("c", window=10.0, agg="rate",
+                            now=3.0) == pytest.approx(2.0)
+        # multiple matching series: summed per reduction
+        assert store.reduce("g", window=10.0, agg="last", now=3.0) == 3.0
+        # a single point inside a window reaching t<=0 rates as 0.0
+        reg2, _, store2 = _store()
+        reg2.inc("c2", 1.0)
+        store2.sample(0.5)
+        assert store2.reduce("c2", window=60.0, agg="rate", now=0.5) == 0.0
+
+    def test_max_series_cap(self):
+        reg, clk, store = _store(max_series=2)
+        for i in range(4):
+            reg.set_gauge("g", 1.0, shard=str(i))
+        store.sample(0.0)
+        st = store.stats()
+        assert st["series"] == 2 and st["dropped_series"] == 2
+
+    def test_last_seen(self):
+        reg, clk, store = _store()
+        assert store.last_seen("g") is None
+        reg.set_gauge("g", 1.0, shard="0")
+        store.sample(3.0)
+        assert store.last_seen("g") == 3.0
+        assert store.last_seen("g", {"shard": "0"}) == 3.0
+        assert store.last_seen("g", {"shard": "9"}) is None
+
+    def test_malformed_construction(self):
+        with pytest.raises(ValueError):
+            SeriesStore(MetricsRegistry(), tiers=())
+        with pytest.raises(ValueError):
+            SeriesStore(MetricsRegistry(), tiers=((0.0, 4),))
+
+    def test_sampler_thread_drives_store_and_callbacks(self):
+        reg, _, _ = _store()
+        reg.set_gauge("g", 1.0)
+        store = SeriesStore(reg, tiers=((0.01, 64),))
+        hits = []
+        s = Sampler(store, interval=0.01,
+                    callbacks=[lambda: hits.append(1),
+                               lambda: 1 / 0])  # raising cb is swallowed
+        s.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while store.stats()["samples"] < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            s.stop()
+        assert store.stats()["samples"] >= 3
+        assert hits
+
+
+# ---------------------------------------------------------------------
+# merge gauge semantics (the cross-shard aggregation contract)
+# ---------------------------------------------------------------------
+class TestMergeGaugeSemantics:
+    def test_merge_never_materializes_label_free_gauge_aggregate(self):
+        parent = MetricsRegistry()
+        child = MetricsRegistry()
+        child.inc("solves_total", 4.0)
+        child.set_gauge("inflight", 2.0)
+        parent.merge(child.snapshot(), shard="0")
+        parent.merge(child.snapshot(), shard="1")
+        snap = parent.snapshot()
+        # counters DO get the label-free fleet aggregate...
+        assert snap["counters"]["solves_total"] == 8.0
+        assert snap["counters"]['solves_total{shard="0"}'] == 4.0
+        # ...gauges deliberately do not: a summed last-write gauge would
+        # go stale the moment one shard stops reporting
+        assert "inflight" not in snap["gauges"]
+        assert snap["gauges"]['inflight{shard="0"}'] == 2.0
+
+    def test_sum_gauges_is_the_explicit_aggregation(self):
+        reg = MetricsRegistry()
+        assert reg.sum_gauges("inflight") is None  # no shards reporting
+        reg.set_gauge("inflight", 2.0, shard="0")
+        reg.set_gauge("inflight", 3.0, shard="1", tenant="a")
+        assert reg.sum_gauges("inflight") == 5.0
+        assert reg.sum_gauges("inflight", shard="1") == 3.0
+        assert reg.sum_gauges("inflight", shard="9") is None
+        # zero in flight stays distinguishable from nobody reporting
+        reg.set_gauge("idle", 0.0, shard="0")
+        assert reg.sum_gauges("idle") == 0.0
+
+
+# ---------------------------------------------------------------------
+# alert rules: validation and the JSON round trip
+# ---------------------------------------------------------------------
+class TestAlertRule:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AlertRule(name="x", series="s", kind="nope")
+        with pytest.raises(ValueError):
+            AlertRule(name="x", series="s", op=">=")
+        with pytest.raises(ValueError):
+            AlertRule(name="x", series="s", severity="critical")
+        # clear_bound must sit on the non-firing side of bound
+        with pytest.raises(ValueError):
+            AlertRule(name="x", series="s", op=">", bound=5.0,
+                      clear_bound=6.0)
+        with pytest.raises(ValueError):
+            AlertRule(name="x", series="s", op="<", bound=1.0,
+                      clear_bound=0.5)
+        AlertRule(name="x", series="s", op="<", bound=1.0, clear_bound=1.5)
+
+    def test_breach_and_clear_orientation(self):
+        hi = AlertRule(name="hi", series="s", op=">", bound=5.0,
+                       clear_bound=3.0)
+        assert hi.breached(6.0) and not hi.breached(5.0)
+        assert not hi.cleared(4.0) and hi.cleared(3.0)  # hysteresis band
+        lo = AlertRule(name="lo", series="s", op="<", bound=1.0)
+        assert lo.breached(0.0) and not lo.breached(1.0)
+        assert lo.cleared(1.0)
+
+    def test_dict_round_trip_spells_for(self):
+        rule = AlertRule(name="x", series="s", op=">", bound=2.0,
+                         for_=15.0, labels={"shard": "0"}, severity="page")
+        d = rule.to_dict()
+        assert d["for"] == 15.0 and "for_" not in d
+        assert rule_from_dict(d) == rule
+        assert rule_from_dict(json.loads(json.dumps(d))) == rule
+        with pytest.raises(ValueError):
+            rule_from_dict({"name": "x", "series": "s", "threshold": 1})
+
+
+# ---------------------------------------------------------------------
+# AlertManager: the firing → resolved lifecycle
+# ---------------------------------------------------------------------
+class TestAlertManager:
+    def _mgr(self, rules, tiers=((1.0, 64),), **kw):
+        reg, clk, store = _store(tiers=tiers)
+        return reg, clk, store, AlertManager(store, rules, clock=clk, **kw)
+
+    def test_lifecycle_counters_gauge_and_journal(self):
+        rule = AlertRule(name="deep", series="depth", op=">", bound=5.0,
+                         clear_bound=3.0, window=10.0, severity="page")
+        tracer = Tracer()
+        with use_tracer(tracer):
+            reg, clk, store, mgr = self._mgr([rule])
+            reg.set_gauge("depth", 1.0)
+            store.sample(0.0)
+            assert mgr.evaluate(0.0) == []
+            reg.set_gauge("depth", 9.0)
+            store.sample(1.0)
+            (tr,) = mgr.evaluate(1.0)
+            assert tr["phase"] == "firing" and tr["value"] == 9.0
+            assert tr["severity"] == "page" and tr["t"] == 1.0
+            (f,) = mgr.firing()
+            assert f["rule"] == "deep" and f["since"] == 1.0
+            snap = reg.snapshot()
+            assert snap["counters"][
+                'alerts_fired_total{rule="deep",severity="page"}'] == 1.0
+            assert snap["gauges"]['alerts_firing{rule="deep"}'] == 1.0
+            # hysteresis: below bound but above clear_bound holds firing
+            reg.set_gauge("depth", 4.0)
+            store.sample(2.0)
+            assert mgr.evaluate(2.0) == [] and mgr.firing()
+            reg.set_gauge("depth", 2.0)
+            store.sample(3.0)
+            (tr,) = mgr.evaluate(3.0)
+            assert tr["phase"] == "resolved" and tr["duration_s"] == 2.0
+            snap = reg.snapshot()
+            assert snap["counters"]['alerts_resolved_total{rule="deep"}'] == 1.0
+            assert snap["gauges"]['alerts_firing{rule="deep"}'] == 0.0
+            assert mgr.firing() == []
+        evs = [e for e in tracer.events
+               if e.get("kind") == "event" and e.get("name") == "alert"]
+        assert [e["phase"] for e in evs] == ["firing", "resolved"]
+        assert evs[0]["rule"] == "deep" and evs[1]["duration_s"] == 2.0
+
+    def test_for_hold_delays_firing(self):
+        rule = AlertRule(name="deep", series="depth", op=">", bound=5.0,
+                         window=10.0, for_=2.0)
+        reg, clk, store, mgr = self._mgr([rule])
+        for t in range(3):
+            reg.set_gauge("depth", 9.0)
+            store.sample(float(t))
+            trs = mgr.evaluate(float(t))
+            if t < 2:
+                assert trs == []  # pending, not yet held for for_
+            else:
+                assert trs and trs[0]["phase"] == "firing"
+        # a dip resets the hold
+        reg2, clk2, store2, mgr2 = self._mgr([rule])
+        for t, v in enumerate([9.0, 1.0, 9.0, 9.0]):
+            reg2.set_gauge("depth", v)
+            store2.sample(float(t))
+            assert mgr2.evaluate(float(t)) == []
+
+    def test_absence_rule(self):
+        rule = AlertRule(name="quiet", series="beat", kind="absence",
+                         window=5.0)
+        reg, clk, store, mgr = self._mgr([rule])
+        # never sampled: silent, not firing
+        assert mgr.evaluate(100.0) == [] and mgr.firing() == []
+        reg.set_gauge("beat", 1.0)
+        store.sample(0.0)
+        assert mgr.evaluate(3.0) == []  # within the window
+        (tr,) = mgr.evaluate(10.0)  # 10s since last sample > 5s window
+        assert tr["phase"] == "firing" and tr["value"] == 10.0
+        store.sample(11.0)  # the series comes back
+        (tr,) = mgr.evaluate(11.0)
+        assert tr["phase"] == "resolved"
+
+    def test_rate_rule_needs_an_increase(self):
+        rule = AlertRule(name="errs", series="errs_total", kind="rate",
+                         op=">", bound=0.0, window=10.0)
+        reg, clk, store, mgr = self._mgr([rule])
+        reg.inc("errs_total", 0.0)  # zero-seed: flat baseline
+        for t in range(3):
+            store.sample(float(t))
+            assert mgr.evaluate(float(t)) == []  # flat counter: no rate
+        reg.inc("errs_total", 5.0)
+        store.sample(3.0)
+        (tr,) = mgr.evaluate(3.0)
+        assert tr["phase"] == "firing"
+        assert tr["value"] == pytest.approx(5.0 / 3.0)
+
+    def test_slo_burn_mirrors_gauge_and_uses_slo_fn(self):
+        rule = AlertRule(name="burn", series="slo_worst_burn_rate",
+                         kind="slo_burn", op=">", bound=14.4,
+                         clear_bound=1.0)
+        burn = {"worst_burn_rate": 20.0}
+        reg, clk, store, mgr = self._mgr([rule])
+        mgr.slo_fn = lambda: burn
+        (tr,) = mgr.evaluate(0.0)
+        assert tr["phase"] == "firing" and tr["value"] == 20.0
+        # the burn reading is mirrored into the registry so the next
+        # sample gives /query a history for it
+        assert reg.snapshot()["gauges"]["slo_worst_burn_rate"] == 20.0
+        store.sample(1.0)
+        (q,) = store.query("slo_worst_burn_rate", window=10.0, now=1.0)
+        assert q["v"] == [20.0]
+        burn["worst_burn_rate"] = 0.5
+        (tr,) = mgr.evaluate(2.0)
+        assert tr["phase"] == "resolved"
+        # a raising slo_fn reads as burn 0, never as a crash
+        mgr.slo_fn = lambda: 1 / 0
+        assert mgr.evaluate(3.0) == []
+
+    def test_maybe_evaluate_rate_limits(self):
+        reg, clk, store, mgr = self._mgr([])
+        mgr.maybe_evaluate(0.0)
+        assert mgr.evals == 1
+        assert mgr.maybe_evaluate(0.5) == []  # < eval_every (raw res)
+        assert mgr.evals == 1
+        mgr.maybe_evaluate(1.0)
+        assert mgr.evals == 2
+
+    def test_per_series_instances(self):
+        rule = AlertRule(name="deep", series="depth", op=">", bound=5.0,
+                         window=10.0)
+        reg, clk, store, mgr = self._mgr([rule])
+        reg.set_gauge("depth", 9.0, shard="0")
+        reg.set_gauge("depth", 1.0, shard="1")
+        store.sample(0.0)
+        (tr,) = mgr.evaluate(0.0)
+        assert tr["series"] == 'depth{shard="0"}'
+        reg.set_gauge("depth", 9.0, shard="1")
+        store.sample(1.0)
+        (tr,) = mgr.evaluate(1.0)
+        assert tr["series"] == 'depth{shard="1"}'
+        assert len(mgr.firing()) == 2
+        assert reg.snapshot()["gauges"]['alerts_firing{rule="deep"}'] == 2.0
+
+    def test_context_captured_on_first_firing_only(self):
+        rule = AlertRule(name="deep", series="depth", op=">", bound=5.0,
+                         window=10.0)
+        reg, clk, store, mgr = self._mgr([rule], journal=False)
+        for t, v in enumerate([9.0, 1.0, 9.0]):  # fire, resolve, re-fire
+            reg.set_gauge("depth", v)
+            store.sample(float(t))
+            mgr.evaluate(float(t))
+        assert len(mgr.captures) == 1
+        cap = mgr.captures[0]
+        assert cap["rule"] == "deep"
+        assert cap["window"] and "gauges" in cap["snapshot"]
+        rep = mgr.report()
+        assert set(rep) == {"firing", "history", "rules", "evals", "captures"}
+        assert [h["phase"] for h in rep["history"]] == [
+            "firing", "resolved", "firing"]
+        assert rep["rules"][0]["for"] == 0.0
+        assert rep["captures"] == [
+            {"rule": "deep", "series": "depth", "t": 0.0}]
+
+    def test_duplicate_rule_names_rejected(self):
+        rule = AlertRule(name="deep", series="depth")
+        with pytest.raises(ValueError):
+            AlertManager(SeriesStore(MetricsRegistry()), [rule, rule])
+
+    def test_default_fleet_rules_pack(self):
+        rules = default_fleet_rules(queue_limit=100, heartbeat_timeout=2.0)
+        by_name = {r.name: r for r in rules}
+        assert set(by_name) == {
+            "shard_down", "shard_pong_wedge", "queue_saturation",
+            "slo_fast_burn", "poison_rate",
+        }
+        assert by_name["queue_saturation"].bound == 80.0
+        assert by_name["shard_pong_wedge"].bound == pytest.approx(1.6)
+        assert by_name["poison_rate"].kind == "rate"
+        # every rule survives the JSON round trip alert_check relies on
+        for r in rules:
+            assert rule_from_dict(json.loads(json.dumps(r.to_dict()))) == r
+
+
+# ---------------------------------------------------------------------
+# control signals: the smoothed readings controllers consume
+# ---------------------------------------------------------------------
+class TestControlSignals:
+    def test_no_data_reads_none(self):
+        reg, clk, store = _store()
+        sig = Signal(store, "g")
+        assert sig.value(0.0) is None and sig.trend(0.0) is None
+        snap = ControlSignals(store).snapshot(0.0)
+        assert set(snap) == set(ControlSignals.NAMES)
+        assert snap["queue_depth"] == {"value": None, "trend": None}
+
+    def test_constant_and_rising_series(self):
+        reg, clk, store = _store()
+        for t in range(6):
+            reg.set_gauge("flat", 3.0)
+            reg.set_gauge("rise", float(t))
+            store.sample(float(t))
+        flat = Signal(store, "flat", window=60.0)
+        assert flat.value(5.0) == pytest.approx(3.0)
+        assert flat.trend(5.0) == pytest.approx(0.0)
+        rise = Signal(store, "rise", window=60.0, half_life=1.0)
+        v = rise.value(5.0)
+        assert 0.0 < v < 5.0
+        assert v > 2.5  # EWMA leans toward the recent samples
+        assert rise.trend(5.0) == pytest.approx(1.0)  # +1 per second
+
+    def test_cache_hit_ratio(self):
+        reg, clk, store = _store()
+        hit = miss = 0.0
+        for t in range(5):
+            hit += 3.0
+            miss += 1.0
+            reg._counters.clear()
+            reg.inc("compile_cache_hit_total", hit)
+            reg.inc("compile_cache_miss_total", miss)
+            store.sample(float(t))
+        sig = ControlSignals(store).compile_cache_hit_rate
+        assert sig.value(4.0) == pytest.approx(0.75)
+
+    def test_utilization_normalizes_and_falls_back(self):
+        reg, clk, store = _store()
+        # store still empty: the instantaneous sum_gauges answers
+        reg.set_gauge("serve_shard_inflight", 2.0, shard="0")
+        reg.set_gauge("serve_shard_inflight", 2.0, shard="1")
+        cs = ControlSignals(store, capacity=8.0)
+        assert cs.shard_inflight_utilization.value(0.0) == pytest.approx(0.5)
+        for t in range(4):
+            store.sample(float(t))
+        assert cs.shard_inflight_utilization.value(3.0) == pytest.approx(0.5)
+        # without capacity the signal reads absolute lanes
+        assert ControlSignals(store).shard_inflight_utilization.value(
+            3.0) == pytest.approx(4.0)
+
+
+# ---------------------------------------------------------------------
+# exporter: /query and /alerts routes (no socket — handle_path)
+# ---------------------------------------------------------------------
+class TestExporterQueryAlerts:
+    def _exp(self, with_alerts=True):
+        reg, clk, store = _store()
+        reg.set_gauge("depth", 4.0, shard="0")
+        reg.set_gauge("depth", 6.0, shard="1")
+        store.sample(1.0)
+        mgr = AlertManager(
+            store, [AlertRule(name="deep", series="depth", op=">",
+                              bound=5.0, window=10.0)],
+            clock=clk, journal=False,
+        )
+        mgr.evaluate(1.0)
+        exp = TelemetryExporter(
+            0, registry=reg, store=store,
+            alerts=mgr if with_alerts else None,
+        )
+        return exp
+
+    def test_query_route(self):
+        exp = self._exp()
+        status, ctype, body = exp.handle_path("/query?name=depth&window=60")
+        assert status == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["name"] == "depth" and doc["window"] == 60.0
+        assert len(doc["series"]) == 2
+        for s in doc["series"]:
+            assert len(s["t"]) == len(s["v"]) > 0
+        # any extra parameter is a label match
+        status, _, body = exp.handle_path("/query?name=depth&shard=1")
+        (s,) = json.loads(body)["series"]
+        assert s["series"] == 'depth{shard="1"}' and s["v"] == [6.0]
+        status, _, body = exp.handle_path("/query?window=60")
+        assert status == 400 and "name" in json.loads(body)["error"]
+        status, _, _ = exp.handle_path("/query?name=depth&agg=bogus")
+        assert status == 500  # broken query must not kill the server
+
+    def test_query_without_store_404s(self):
+        exp = TelemetryExporter(0, registry=MetricsRegistry())
+        status, _, body = exp.handle_path("/query?name=depth")
+        assert status == 404 and b"no series store" in body
+
+    def test_alerts_route(self):
+        exp = self._exp()
+        status, _, body = exp.handle_path("/alerts")
+        assert status == 200
+        rep = json.loads(body)
+        assert rep["firing"][0]["rule"] == "deep"
+        assert rep["rules"][0]["name"] == "deep"
+        status, _, body = self._exp(with_alerts=False).handle_path("/alerts")
+        assert status == 404 and b"no alert manager" in body
+
+
+# ---------------------------------------------------------------------
+# fleet wiring under timeseries=True: fake clock, stub shards
+# ---------------------------------------------------------------------
+class _FakeShard:
+    """ShardProcess surface with no child (same shape as the
+    test_serve_fleet stub): dies on command, never answers."""
+
+    def __init__(self, shard_id, bucket=2):
+        self.shard_id = shard_id
+        self.bucket = bucket
+        self.solver_kw = {"max_iter": 40}
+        self.lanes = {}
+        self.proc = None
+        self.spawned_at = 0.0
+        self.spawn_count = 0
+        self.last_ping = None
+        self.last_pong = 0.0
+        self._alive = False
+
+    def spawn(self):
+        self._alive = True
+        self.spawn_count += 1
+        self.spawned_at = time.monotonic()
+        self.last_ping = None
+        self.last_pong = self.spawned_at
+
+    def die(self):
+        self._alive = False
+
+    def kill(self):
+        self._alive = False
+
+    def alive(self):
+        return self._alive
+
+    def exit_code(self):
+        return None if self._alive else -9
+
+    def wedged(self, heartbeat_timeout):
+        return False
+
+    def ping(self):
+        self.last_ping = self.last_pong = time.monotonic()
+
+    def poll(self):
+        return []
+
+    def solve(self, lane, req):
+        if not self._alive:
+            return False
+        self.lanes[lane] = req
+        return True
+
+    def cancel(self, lane):
+        self.lanes.pop(lane, None)
+
+    def inject_fault(self, mode):
+        return self._alive
+
+    def inflight(self):
+        return len(self.lanes)
+
+
+class TestFleetTimeseriesWiring:
+    def test_off_by_default(self):
+        reset_metrics()
+        fleet = FleetService([_FakeShard(0)], clock=Clk(), cache=None)
+        try:
+            assert fleet.store is None and fleet.alerts is None
+            st = fleet.stats()
+            assert "timeseries" not in st and "alerts_firing" not in st
+        finally:
+            fleet.close()
+
+    def test_shard_down_fires_and_resolves_on_fake_clock(self):
+        # the deterministic twin of the loadgen chaos assertion: kill →
+        # shard_down fires on the very pump that downs the shard (the
+        # forced sample), respawn → it resolves, with the journal
+        # carrying both transitions
+        reset_metrics()
+        clk = Clk()
+        fake = _FakeShard(0)
+        tracer = Tracer()
+        with use_tracer(tracer):
+            fleet = FleetService(
+                [fake], clock=clk, cache=None, respawn_backoff=0.05,
+                timeseries=True,
+            )
+            try:
+                assert fleet.store is not None and fleet.alerts is not None
+                fleet.pump()  # first cadence sample, shard healthy
+                clk.advance(1.0)
+                fleet.pump()
+                assert fleet.alerts.firing() == []
+                fake.die()
+                clk.advance(1.0)
+                fleet.pump()  # supervision downs the shard → forced sample
+                assert any(f["rule"] == "shard_down"
+                           for f in fleet.alerts.firing())
+                st = fleet.stats()
+                assert st["timeseries"]["samples"] >= 3
+                assert any(f["rule"] == "shard_down"
+                           for f in st["alerts_firing"])
+                time.sleep(0.06)  # respawn backoff runs on the real clock
+                clk.advance(1.0)
+                fleet.pump()  # respawn flips the up gauge → forced sample
+                assert fake.spawn_count == 2
+                assert not any(f["rule"] == "shard_down"
+                               for f in fleet.alerts.firing())
+                # the up/down history landed in the store for /query
+                (q,) = fleet.store.query(
+                    "serve_shard_up", {"shard": "0"}, window=300.0,
+                    now=clk(),
+                )
+                assert 0.0 in q["v"] and 1.0 in q["v"]
+            finally:
+                fleet.close()
+        evs = [e for e in tracer.events
+               if e.get("kind") == "event" and e.get("name") == "alert"
+               and e.get("rule") == "shard_down"]
+        assert [e["phase"] for e in evs] == ["firing", "resolved"]
+        assert evs[1]["duration_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------
+# the two deliberately-real tests (each pays a jax compile)
+# ---------------------------------------------------------------------
+class TestTimeseriesNeutrality:
+    def test_service_results_bitwise_identical_with_plane_on(self):
+        reset_metrics()
+        lps = [_lp(s) for s in range(3)]
+        plain = make_dense_service(2, chunk_iters=4, cache_size=None,
+                                   max_iter=40)
+        tickets = [plain.submit(lp) for lp in lps]
+        plain.drain()
+        ref = [t.result(0) for t in tickets]
+
+        svc = make_dense_service(2, chunk_iters=4, cache_size=None,
+                                 max_iter=40, timeseries=True)
+        assert svc.store is not None
+        tickets = [svc.submit(lp) for lp in lps]
+        svc.drain()
+        got = [t.result(0) for t in tickets]
+        for g, r in zip(got, ref):
+            assert g.verdict == r.verdict
+            assert g.iterations == r.iterations
+            for a, b in zip(g.solution, r.solution):
+                assert _biteq(a, b)
+        # the plane actually retained something while solving
+        assert svc.store.stats()["samples"] >= 1
+
+
+_SCRAPE_PATHS = (
+    "/metrics",
+    "/snapshot",
+    "/query?name=serve_queue_depth&window=300",
+    "/query?name=serve_shard_inflight&window=300&agg=raw",
+    "/alerts",
+)
+
+_PROM_LINE = re.compile(
+    r"^[A-Za-z_:][A-Za-z0-9_:]*(\{[^}]*\})? \S+$"
+)
+
+
+class TestExporterConcurrentScrape:
+    def _check_metrics_body(self, body):
+        text = body.decode("utf-8")  # torn writes would break utf-8/format
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert _PROM_LINE.match(line), f"torn exposition line: {line!r}"
+            float(line.rsplit(" ", 1)[1])
+
+    def test_scrape_storm_under_fleet_chaos(self):
+        from dispatches_tpu.serve import make_dense_fleet
+
+        reset_metrics()
+        fleet = make_dense_fleet(
+            2, 2, chunk_iters=2, cache_size=None, respawn_backoff=0.05,
+            solver_kw={"max_iter": 120}, telemetry=True,
+            heartbeat_every=0.05, timeseries=True,
+        )
+        exp = TelemetryExporter(
+            0, health_fn=fleet.health, store=fleet.store,
+            alerts=fleet.alerts,
+        )
+        stop = threading.Event()
+        errors = []
+        scrapes = [0]
+
+        def hammer():
+            while not stop.is_set():
+                for path in _SCRAPE_PATHS:
+                    try:
+                        status, _, body = exp.handle_path(path)
+                        if status >= 500:
+                            errors.append((path, status, body[:200]))
+                        elif path == "/metrics":
+                            self._check_metrics_body(body)
+                        else:
+                            json.loads(body)
+                        scrapes[0] += 1
+                    except Exception as e:  # noqa: BLE001
+                        errors.append((path, "exc", repr(e)))
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(6)]
+        try:
+            fleet.start()
+            for th in threads:
+                th.start()
+            tickets = [fleet.submit(_lp(700 + s)) for s in range(8)]
+            victim = None
+            t0 = time.monotonic()
+            while victim is None and time.monotonic() - t0 < 60.0:
+                for sid, st in fleet.shard_states().items():
+                    if st["state"] == "up" and st["inflight"] > 0:
+                        victim = sid
+                        break
+                time.sleep(0.005)
+            assert victim is not None
+            fleet.kill_shard(victim)
+            results = [t.result(timeout=240.0) for t in tickets]
+            assert all(r.verdict in ("healthy", "slow") for r in results)
+            assert fleet.respawn_total >= 1
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=10.0)
+            fleet.close()
+        assert not errors, errors[:5]
+        assert scrapes[0] > 0
+        # conservation exact: every submitted request resolved exactly
+        # once, regardless of the kill/requeue path the storm observed
+        counters = obs_metrics.snapshot()["counters"]
+        total = sum(v for s, v in counters.items()
+                    if s.startswith("serve_requests_total{"))
+        assert total == float(len(tickets))

@@ -14,6 +14,9 @@ Two sources:
   `obs.exporter.TelemetryExporter` (``/snapshot`` for the registry,
   ``/healthz`` for liveness, ``/slo`` for burn rates) every
   ``--interval`` seconds; qps comes from counter deltas between polls.
+  When the exporter has a `SeriesStore` attached, ``/query`` windows
+  become unicode sparklines (queue depth, per-shard in-flight) and
+  ``/alerts`` becomes a firing-alerts panel under the table.
 - **offline**: ``--snapshot FILE`` renders one frame from a registry
   snapshot JSON (an exporter ``/snapshot`` capture, or the ``metrics``
   field of a journal's close record).
@@ -86,7 +89,8 @@ def parse_series(series: str) -> Tuple[str, Dict[str, str]]:
 def hist_quantile(h: Dict[str, Any], q: float) -> Optional[float]:
     """q-quantile of a snapshot histogram dict (Prometheus-style linear
     interpolation; +Inf observations clamp to the largest finite bound).
-    Mirrors `MetricsRegistry.histogram_quantile`."""
+    Mirrors `MetricsRegistry.histogram_quantile`: None for an empty or
+    all-zero ladder, which every renderer shows as an em dash."""
     count = int(h.get("count") or 0)
     if not count:
         return None
@@ -94,6 +98,8 @@ def hist_quantile(h: Dict[str, Any], q: float) -> Optional[float]:
         (float("inf") if b == "+Inf" else float(b), int(c))
         for b, c in (h.get("buckets") or {}).items()
     )
+    if not any(c for _, c in buckets):
+        return None
     finite = [(b, c) for b, c in buckets if b != float("inf")]
     rank = q * count
     cum = 0.0
@@ -204,8 +210,69 @@ def aggregate_requests(snap: Dict[str, Any]) -> int:
 
 def _fmt(v: Any, scale: float = 1.0, unit: str = "", nd: int = 1) -> str:
     if v is None:
-        return "-"
+        return "—"  # uniform "no data": empty/all-zero histogram ladders
     return f"{float(v) * scale:.{nd}f}{unit}"
+
+
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def spark(vals: List[float], width: int = 32) -> str:
+    """Unicode sparkline of a value window (most recent `width` points).
+    A flat series renders as its low glyph, an empty one as nothing."""
+    vals = [float(v) for v in vals][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_GLYPHS[0] * len(vals)
+    return "".join(
+        _SPARK_GLYPHS[
+            min(int((v - lo) / span * len(_SPARK_GLYPHS)), len(_SPARK_GLYPHS) - 1)
+        ]
+        for v in vals
+    )
+
+
+def spark_lines(queries: Dict[str, Optional[Dict[str, Any]]]) -> List[str]:
+    """Sparkline rows from ``/query`` responses, one per series — the
+    label keeps the shard tag so per-shard in-flight windows stay
+    distinguishable."""
+    lines: List[str] = []
+    for name, q in sorted(queries.items()):
+        for s in (q or {}).get("series") or []:
+            vals = s.get("v") or []
+            if not vals:
+                continue
+            _, labels = parse_series(s["series"])
+            tag = name + (f"[{labels['shard']}]" if "shard" in labels else "")
+            lines.append(
+                f"  {tag:<28} {spark(vals):<32} last {_fmt(vals[-1])}"
+            )
+    return lines
+
+
+def alert_lines(alerts: Optional[Dict[str, Any]]) -> List[str]:
+    """The firing-alerts panel from an ``/alerts`` report: one row per
+    firing instance, plus a one-line OK when the pack is quiet."""
+    if not alerts or not isinstance(alerts.get("firing"), list):
+        return []
+    sev = {
+        r.get("name"): r.get("severity", "warn")
+        for r in alerts.get("rules") or []
+    }
+    firing = alerts["firing"]
+    if not firing:
+        return [f"alerts: none firing ({len(sev)} rule(s) quiet)"]
+    lines = [f"alerts: {len(firing)} FIRING"]
+    for f in firing:
+        lines.append(
+            f"  !! {f['rule']}({sev.get(f['rule'], '?')})  {f['series']}"
+            f"  value={_fmt(f.get('value'), nd=3)}"
+            f"  fired×{f.get('fired_count', 1)}"
+        )
+    return lines
 
 
 def render(
@@ -214,6 +281,8 @@ def render(
     slo: Optional[Dict[str, Any]] = None,
     prev: Optional[Dict[str, Any]] = None,
     dt: Optional[float] = None,
+    queries: Optional[Dict[str, Optional[Dict[str, Any]]]] = None,
+    alerts: Optional[Dict[str, Any]] = None,
 ) -> str:
     rows = fleet_rows(snap, health, prev, dt)
     n_down = sum(1 for r in rows if not r["up"])
@@ -261,6 +330,12 @@ def render(
             for name, s in sorted(slo["slos"].items())
         ]
         lines.append("burn rates  " + "  ".join(parts))
+    if queries:
+        sl = spark_lines(queries)
+        if sl:
+            lines.append("history (5m)")
+            lines.extend(sl)
+    lines.extend(alert_lines(alerts))
     return "\n".join(lines)
 
 
@@ -293,6 +368,17 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
             return 1
         health = _get_json(url + "/healthz")
         slo = _get_json(url + "/slo")
+        # /query + /alerts 404 on exporters without a store/manager
+        # attached; _get_json turns that into None and the panels vanish
+        queries = {
+            name: _get_json(url + f"/query?name={name}&window=300")
+            for name in ("serve_queue_depth", "serve_shard_inflight")
+        }
+        queries = {k: v for k, v in queries.items()
+                   if v and not v.get("error")}
+        alerts = _get_json(url + "/alerts")
+        if alerts and alerts.get("error"):
+            alerts = None
         now = time.monotonic()
         dt = (now - prev_t) if prev_t is not None else None
         if as_json:
@@ -301,9 +387,10 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
                 "aggregate_requests": aggregate_requests(snap),
                 "health": health,
                 "worst_burn_rate": (slo or {}).get("worst_burn_rate"),
+                "alerts_firing": (alerts or {}).get("firing"),
             }, default=str))
         else:
-            out = render(snap, health, slo, prev, dt)
+            out = render(snap, health, slo, prev, dt, queries, alerts)
             if not once:
                 print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
             print(out, flush=True)
@@ -399,6 +486,61 @@ def self_check() -> int:
     q99 = hist_quantile(snap["histograms"]['serve_shard_latency_seconds{shard="0"}'], 0.999)
     check("+Inf tail clamps to top bound", q99 == 0.25, str(q99))
     check("empty histogram -> None", hist_quantile({"count": 0, "buckets": {}}, 0.5) is None)
+    check(
+        "all-zero ladder -> None",
+        hist_quantile({"count": 3, "buckets": {"0.05": 0, "+Inf": 0}}, 0.5)
+        is None,
+    )
+    check("None renders as em dash", _fmt(None) == "—")
+
+    # sparklines + alerts panels
+    check("spark spans glyph range", spark([0, 1, 2, 3]) == "▁▃▆█",
+          spark([0, 1, 2, 3]))
+    check("spark flat series", spark([5.0, 5.0]) == "▁▁", spark([5.0, 5.0]))
+    check("spark empty", spark([]) == "")
+    q = {
+        "serve_queue_depth": {
+            "series": [
+                {"series": "serve_queue_depth", "t": [1, 2], "v": [0.0, 4.0]},
+            ],
+        },
+        "serve_shard_inflight": {
+            "series": [
+                {"series": 'serve_shard_inflight{shard="0"}',
+                 "t": [1, 2], "v": [1.0, 2.0]},
+                {"series": 'serve_shard_inflight{shard="1"}', "t": [], "v": []},
+            ],
+        },
+    }
+    sl = spark_lines(q)
+    check(
+        "spark_lines labels shards, skips empty windows",
+        len(sl) == 2 and any("serve_shard_inflight[0]" in x for x in sl),
+        str(sl),
+    )
+    al = alert_lines({
+        "firing": [{"rule": "shard_down", "series": 'serve_shard_up{shard="1"}',
+                    "value": 0.0, "fired_count": 2}],
+        "rules": [{"name": "shard_down", "severity": "page"}],
+    })
+    check(
+        "alert panel shows severity + instance",
+        len(al) == 2 and "shard_down(page)" in al[1] and "FIRING" in al[0],
+        str(al),
+    )
+    check(
+        "alert panel quiet line",
+        alert_lines({"firing": [], "rules": [{"name": "r"}]})
+        == ["alerts: none firing (1 rule(s) quiet)"],
+    )
+    out_full = render(snap, queries=q, alerts={
+        "firing": [{"rule": "shard_down", "series": "s", "value": 0.0}],
+        "rules": [],
+    })
+    check(
+        "render appends history + alert panels",
+        "history (5m)" in out_full and "FIRING" in out_full,
+    )
 
     out = render(
         snap,

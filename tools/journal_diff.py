@@ -62,6 +62,10 @@ _HIGHER_IS_BETTER = (
     "throughput", 'verdict="healthy"', "iters_saved", "cache_hit",
     "lanes_retired", "goodput", "terminal/complete", "telemetry_frames",
     "learned_warm_accept", "remediation_recovered",
+    # alert lifecycle (obs/alerts.py): RESOLUTIONS are the good half —
+    # an alert that fired and resolved is a recovery; fired_total and the
+    # alerts_firing steady-state gauge fall through to lower-is-better
+    "alerts_resolved",
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
@@ -94,6 +98,13 @@ _ZERO_SEEDED = (
     # only gate on a same-workload DROP (ladder stopped winning).
     "remediation_attempts_total", "remediation_recovered_total",
     "poisoned_requests_total",
+    # alerting (obs/alerts.py): fired counters and the currently-firing
+    # gauge only exist once a rule tripped — a clean baseline has no
+    # alert series at all. Seeding makes a page appearing in NEW a gated
+    # regression, and a non-zero alerts_firing close snapshot (the run
+    # ENDED degraded) gates even harder; resolved counters seed too but
+    # gate only on a same-workload drop (recoveries stopped happening).
+    "alerts_fired_total", "alerts_resolved_total", "alerts_firing",
 )
 
 
@@ -285,6 +296,15 @@ def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
                         p = _hist_p95(h)
                         if p is not None:
                             out[f"metric/{series}/p95"] = p
+                for series, v in (mets.get("gauges") or {}).items():
+                    # alerts_firing at close == the run ended degraded;
+                    # retained quantile tracks (<hist>_p95{...}) give the
+                    # /query-derived latency surface a comparable row
+                    if _is_num(v) and (
+                        series.startswith("alerts_firing")
+                        or "_p9" in series or "_p50" in series
+                    ):
+                        out[f"metric/{series}"] = float(v)
     for pri, vs in lat_by_pri.items():
         out[f"journey/{pri}/latency_p95_s"] = _p95(vs)
     for pri, vs in qw_by_pri.items():
@@ -772,6 +792,53 @@ def self_check(out=sys.stdout) -> int:
     })
     checks.append((
         "recoveries alone appearing vs clean baseline pass "
+        "(higher-is-better never gates on growth)",
+        False, any(r["regression"] for r in rows)))
+
+    # alerting (obs/alerts.py + obs/timeseries.py): fired counters gate
+    # appearing-from-zero, the alerts_firing close gauge gates on any
+    # non-zero steady state (the run ended degraded), resolutions are
+    # the good half, and the store's retained quantile tracks give
+    # /query-derived p95s the same lower-is-better treatment as
+    # close-snapshot histogram p95s
+    gbase = {
+        'metric/alerts_fired_total{rule="shard_down",severity="page"}': 2.0,
+        'metric/alerts_resolved_total{rule="shard_down"}': 2.0,
+        'metric/alerts_firing{rule="shard_down"}': 0.0,
+        'metric/serve_shard_latency_seconds_p95{shard="0"}': 0.040,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def grun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(gbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    grun("identical alert metrics pass", dict(gbase), False)
+    grun("fired count tripling fails (lower is better)",
+         {**gbase,
+          'metric/alerts_fired_total{rule="shard_down",severity="page"}':
+          6.0}, True)
+    grun("firing steady-state appearing at close fails (ended degraded)",
+         {**gbase, 'metric/alerts_firing{rule="shard_down"}': 1.0}, True)
+    grun("resolved count dropping >10% fails (recoveries stopped)",
+         {**gbase, 'metric/alerts_resolved_total{rule="shard_down"}': 1.0},
+         True)
+    grun("query-derived p95 track regression >10% fails (lower is better)",
+         {**gbase,
+          'metric/serve_shard_latency_seconds_p95{shard="0"}': 0.060}, True)
+    grun("query-derived p95 track improving passes",
+         {**gbase,
+          'metric/serve_shard_latency_seconds_p95{shard="0"}': 0.020}, False)
+    cleang = {"serve/loadgen/goodput_rps": 120.0}
+    rows = compare(cleang, gbase)
+    checks.append((
+        "pages appearing vs alert-free baseline fail (zero-seeded)",
+        True, any(r["regression"] for r in rows)))
+    rows = compare(cleang, {
+        **cleang, 'metric/alerts_resolved_total{rule="shard_down"}': 2.0,
+    })
+    checks.append((
+        "resolutions alone appearing vs clean baseline pass "
         "(higher-is-better never gates on growth)",
         False, any(r["regression"] for r in rows)))
 

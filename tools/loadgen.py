@@ -519,6 +519,53 @@ def _telemetry_checks(fleet, exporter, before, n_solved, out) -> list:
     return failures
 
 
+def _timeseries_checks(exporter, out) -> list:
+    """Retention-plane acceptance over the exporter surface: ``/query``
+    must return non-empty aligned windows for the queue-depth and
+    per-shard in-flight gauges the pump has been sampling, and
+    ``/alerts`` must serve the rule pack + the shard_down lifecycle the
+    chaos leg just induced."""
+    failures = []
+    for name in ("serve_queue_depth", "serve_shard_inflight"):
+        code, body = _http_get(exporter.url(f"/query?name={name}&window=300"))
+        if code != 200:
+            failures.append(f"timeseries: /query?name={name} returned {code}")
+            continue
+        series = json.loads(body).get("series") or []
+        pts = sum(len(s.get("t") or []) for s in series)
+        misaligned = [
+            s["series"] for s in series
+            if len(s.get("t") or []) != len(s.get("v") or [])
+        ]
+        if not pts:
+            failures.append(f"timeseries: /query {name} window is empty")
+        elif misaligned:
+            failures.append(
+                f"timeseries: /query {name} t/v misaligned: {misaligned}"
+            )
+        else:
+            print(
+                f"timeseries: /query {name}: {len(series)} series, "
+                f"{pts} aligned points", file=out,
+            )
+    code, body = _http_get(exporter.url("/alerts"))
+    if code != 200:
+        failures.append(f"timeseries: /alerts returned {code}")
+    else:
+        rep = json.loads(body)
+        rules = {r.get("name") for r in rep.get("rules") or []}
+        if "shard_down" not in rules:
+            failures.append(
+                f"timeseries: /alerts rule pack lacks shard_down ({rules})"
+            )
+        hist_rules = {h.get("rule") for h in rep.get("history") or []}
+        if "shard_down" not in hist_rules:
+            failures.append(
+                "timeseries: /alerts history lacks the shard_down lifecycle"
+            )
+    return failures
+
+
 def _fleet_chaos_pass(out) -> list:
     """The fleet's acceptance scenario: a 2-shard fleet with one shard
     SIGKILLed while it holds in-flight lanes must (a) lose zero tickets,
@@ -533,9 +580,13 @@ def _fleet_chaos_pass(out) -> list:
     and asserts the plane's own contracts: /healthz flips non-200 while
     the shard is down and heals after respawn, both children's series
     reach /metrics, and the fleet aggregates equal the sum of the
-    per-shard series (see `_telemetry_checks`). The bitwise comparison
-    in (d) therefore also witnesses telemetry-neutrality: results with
-    the whole plane enabled match a plain single-engine service."""
+    per-shard series (see `_telemetry_checks`). With ``timeseries=True``
+    it additionally asserts the retention/alerting plane: the
+    shard_down rule fires during the kill window and resolves after the
+    respawn, and the exporter's /query + /alerts surfaces answer (see
+    `_timeseries_checks`). The bitwise comparison in (d) therefore also
+    witnesses telemetry-neutrality: results with the whole plane
+    enabled match a plain single-engine service."""
     import numpy as np
 
     from dispatches_tpu.obs import metrics as obs_metrics
@@ -556,8 +607,11 @@ def _fleet_chaos_pass(out) -> list:
         tenants={"limited": TenantConfig(rate=0.001, burst=1.0)},
         solver_kw={"max_iter": 60},
         reqtrace=True, telemetry=True, heartbeat_every=0.1,
+        timeseries=True,
     )
-    exporter = TelemetryExporter(0, health_fn=fleet.health).start()
+    exporter = TelemetryExporter(
+        0, health_fn=fleet.health, store=fleet.store, alerts=fleet.alerts,
+    ).start()
     lost = 0
     results = {}
     try:
@@ -616,6 +670,23 @@ def _fleet_chaos_pass(out) -> list:
                 )
             else:
                 print("fleet chaos: /healthz 503 while shard down", file=out)
+            # the alerting view of the same crash: the shard_down rule
+            # must fire while the shard is down (the kill forces an
+            # immediate sample+evaluate, so this is one pump away)
+            fired = False
+            t0 = time.monotonic()
+            while not fired and time.monotonic() - t0 < 30.0:
+                fleet.pump()
+                fired = any(
+                    f["rule"] == "shard_down" for f in fleet.alerts.firing()
+                )
+            if fired:
+                print("fleet chaos: shard_down alert FIRING during kill "
+                      "window", file=out)
+            else:
+                failures.append(
+                    "fleet chaos: shard_down alert never fired after kill"
+                )
         fleet.drain(timeout=300.0)
         if victim is not None:
             # ...and heal back to 200 once the respawn landed (drain
@@ -634,6 +705,32 @@ def _fleet_chaos_pass(out) -> list:
                 )
             else:
                 print("fleet chaos: /healthz healed after respawn", file=out)
+            # ...and the alert must RESOLVE once the respawned shard's up
+            # gauge lands in the store (the respawn forces a sample too)
+            t0 = time.monotonic()
+            still = True
+            while still and time.monotonic() - t0 < 30.0:
+                fleet.pump()
+                still = any(
+                    f["rule"] == "shard_down" for f in fleet.alerts.firing()
+                )
+                if still:
+                    time.sleep(0.05)
+            phases = [
+                h["phase"] for h in fleet.alerts.report()["history"]
+                if h["rule"] == "shard_down"
+            ]
+            if still or "resolved" not in phases:
+                failures.append(
+                    f"fleet chaos: shard_down alert never resolved after "
+                    f"respawn (history phases: {phases})"
+                )
+            else:
+                print(
+                    "fleet chaos: shard_down alert resolved after respawn "
+                    f"(lifecycle: {phases})", file=out,
+                )
+        failures += _timeseries_checks(exporter, out)
         st = fleet.stats()
         for s, t in tickets.items():
             if t.done():

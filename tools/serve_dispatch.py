@@ -165,6 +165,11 @@ def main(argv=None, out=sys.stdout) -> int:
     ap.add_argument("--telemetry", action="store_true",
                     help="--shards mode: children ship metrics-registry "
                     "and journal deltas into the parent on the heartbeat")
+    ap.add_argument("--timeseries", action="store_true",
+                    help="retain sampled metrics history and run the "
+                    "fleet alert pack (obs.timeseries / obs.alerts); "
+                    "adds /query and /alerts to the exporter — "
+                    "docs/observability.md §10")
     ap.add_argument("--exporter-port", type=int, default=None,
                     help="serve /metrics /healthz /slo /snapshot on this "
                     "port (0 = ephemeral, printed to stderr; implies "
@@ -230,6 +235,7 @@ def main(argv=None, out=sys.stdout) -> int:
                                     args.exporter_port is not None
                                 ),
                                 warm_model=args.warm_model,
+                                timeseries=args.timeseries,
                                 solver_kw={"max_iter": args.max_iter},
                             )
                         else:
@@ -240,8 +246,15 @@ def main(argv=None, out=sys.stdout) -> int:
                                 cache_size=args.cache_size or None,
                                 reqtrace=args.reqtrace,
                                 warm_model=args.warm_model,
+                                timeseries=args.timeseries,
                             )
                         svc.start()
+                        if exporter is not None and args.timeseries:
+                            # late-bind: the exporter predates the lazily
+                            # built service; /query and /alerts read these
+                            # attributes per request
+                            exporter.store = svc.store
+                            exporter.alerts = getattr(svc, "alerts", None)
                     kw = {}
                     if args.shards > 0:
                         kw["tenant"] = req.get("tenant", "default")

@@ -432,6 +432,8 @@ def _snapshot_quantile(hist: dict, q: float):
         total = hist["count"]
         if not total:
             return None
+        if not any(hist["buckets"].values()):
+            return None  # all-zero ladder: no data, not "p95 = 0"
         rank = q * total
         cum = 0.0
         prev_bound = 0.0
@@ -451,6 +453,13 @@ def _snapshot_quantile(hist: dict, q: float):
         return None
 
 
+def _fmt_ms(v) -> str:
+    """Em dash for a None quantile (empty/all-zero ladder) — the same
+    "no data" mark fleet_top uses, so mixed-empty series still get a
+    row instead of silently vanishing from the summary."""
+    return "—" if v is None else f"{v * 1e3:.1f}ms"
+
+
 def _print_serve_latency(histograms: dict, out) -> None:
     """One line per serve_latency_seconds{...} series: count + p50/p95."""
     for series in sorted(histograms):
@@ -459,11 +468,9 @@ def _print_serve_latency(histograms: dict, out) -> None:
         h = histograms[series]
         p50 = _snapshot_quantile(h, 0.5)
         p95 = _snapshot_quantile(h, 0.95)
-        if p50 is None or p95 is None:
-            continue
         print(
             f"  serve latency {series[len('serve_latency_seconds'):] or '{}'}:"
-            f" n={h.get('count')} p50~{p50 * 1e3:.1f}ms p95~{p95 * 1e3:.1f}ms",
+            f" n={h.get('count')} p50~{_fmt_ms(p50)} p95~{_fmt_ms(p95)}",
             file=out,
         )
 
