@@ -43,14 +43,33 @@ Three pillars (see docs/observability.md):
    journaled and metered, and EWMA-smoothed `Signal.value()/trend()`
    control signals for the future autoscaler — served over the
    exporter's ``/query`` + ``/alerts``.
+11. **Performance observatory** (`obs.perf`, `obs.benchstore`, the HLO
+   ledger in `obs.cost`): an opt-in `PerfProbe` attributing measured
+   chunk wall time to causal phases (transfer / dispatch-compile /
+   compute / harvest) with an exact phase-sum contract and bitwise
+   neutrality, ``compile_seconds`` hit/cold telemetry + schema-v4
+   ``compile_event`` journal records, per-chunk measured-roofline
+   gauges (model FLOPs ÷ measured wall vs the chip peak anchor), a
+   per-op HLO FLOP/byte ledger (`tools/hlo_top.py`), and an
+   append-only fingerprinted bench history with MAD-based trend
+   gating (`tools/bench_history.py`).
 """
+from .benchstore import (  # noqa: F401
+    append_entry,
+    make_entry,
+    read_history,
+    trend_gate,
+)
 from .cost import (  # noqa: F401
     chip_peak_tflops,
     compiled_cost,
+    hlo_ledger,
+    jit_ledger,
     lp_banded_batch_cost,
     lp_banded_cost,
     lp_solve_cost,
     nlp_solve_cost,
+    parse_hlo_module,
     pdhg_solve_cost,
     roofline,
     with_roofline,
@@ -99,6 +118,7 @@ from .metrics import (  # noqa: F401
     snapshot_delta,
     sum_gauges,
 )
+from .perf import PerfProbe  # noqa: F401
 from .profile import (  # noqa: F401
     annotation,
     profile_capture,
@@ -238,4 +258,12 @@ __all__ = [
     "Signal",
     "ControlSignals",
     "sum_gauges",
+    "PerfProbe",
+    "parse_hlo_module",
+    "hlo_ledger",
+    "jit_ledger",
+    "make_entry",
+    "append_entry",
+    "read_history",
+    "trend_gate",
 ]

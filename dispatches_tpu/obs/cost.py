@@ -27,12 +27,24 @@ Per-solver helpers (`lp_solve_cost`, `lp_banded_cost`,
 two of the four entry points are plain Python wrappers over an inner jit —
 the helper re-wraps them with their static arguments closed over so
 `.lower` exists.
+
+The **per-op HLO ledger** (`parse_hlo_module` / `hlo_ledger` /
+`jit_ledger`, rendered by `tools/hlo_top.py`) breaks the aggregate
+cost-analysis totals down by opcode and by instruction: which dots,
+triangular solves, and Cholesky factorizations actually carry the FLOPs
+of one compiled entry point — the concrete kernel target list ROADMAP
+item 5 (Pallas KKT kernels) needs. FLOP counts are a static estimate
+from shapes (2·K per dot output element, n³/3 per Cholesky, one per
+elementwise output element; loop and fusion bodies counted ONCE — XLA's
+own cost analysis makes the same static approximation for unknown trip
+counts), so treat ledger FLOPs as relative weight, not absolute truth.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -228,3 +240,306 @@ def pdhg_solve_cost(lp: Any, **solver_kw: Any) -> Dict[str, Any]:
     rec = compiled_cost(solve_lp_pdhg, lp, **solver_kw)
     rec["solver"] = "solve_lp_pdhg"
     return rec
+
+
+# -- per-op HLO ledger -------------------------------------------------
+# Shape-based static accounting over the *optimized* HLO text. Every
+# extractor is best-effort line-by-line: an HLO dialect quirk skips one
+# instruction, never the ledger.
+
+# "f32[8,6]{1,0}" / "pred[]" / "bf16[4]" — one array-shape literal
+_SHAPE_RE = re.compile(
+    r"(pred|[subfc]\d+(?:e\d+m\d+(?:fn|b11fnuz|fnuz)?)?)\[([\d,\s]*)\]"
+)
+_OPCODE_RE = re.compile(r"\s*([a-z][\w\-]*)\(")
+_NAME_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([\d,\s]*)\}")
+
+# opcodes that are pure data movement: 0 FLOPs, bytes still counted
+_MOVEMENT_OPS = frozenset(
+    "parameter constant tuple get-tuple-element copy copy-start copy-done "
+    "bitcast bitcast-convert transpose reshape broadcast slice "
+    "dynamic-slice dynamic-update-slice concatenate gather iota reverse "
+    "pad convert after-all partition-id replica-id domain "
+    "get-dimension-size custom-call infeed outfeed send recv".split()
+)
+# elementwise ops costing more than one flop per output element get the
+# transcendental count too (matches cost_analysis()'s bucket)
+_TRANSCENDENTAL_OPS = frozenset(
+    "exponential exponential-minus-one log log-plus-one power sqrt rsqrt "
+    "cbrt tanh sine cosine tan atan2 erf logistic divide".split()
+)
+
+
+def _dtype_bytes(dtype: str) -> int:
+    if dtype == "pred":
+        return 1
+    m = re.match(r"[subfc](\d+)", dtype)
+    if not m:
+        return 4
+    return max(1, int(m.group(1)) // 8)
+
+
+def _parse_shape(text: str) -> Tuple[int, int]:
+    """Total (elements, bytes) over every array-shape literal in `text`
+    (a tuple type contributes the sum of its components)."""
+    elems = nbytes = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            d = d.strip()
+            if d:
+                n *= int(d)
+        elems += n
+        nbytes += n * _dtype_bytes(dtype)
+    return elems, nbytes
+
+
+def _split_instr(rest: str) -> Optional[Tuple[str, str, str, str]]:
+    """Split ``<type> <opcode>(<operands>)<attrs>`` handling tuple types
+    and nested operand parens. Returns (type, opcode, operands, attrs)."""
+    rest = rest.strip()
+    if rest.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rest):
+            depth += ch == "("
+            depth -= ch == ")"
+            if depth == 0:
+                break
+        else:
+            return None
+        type_str, tail = rest[: i + 1], rest[i + 1:]
+    else:
+        parts = rest.split(None, 1)
+        if len(parts) != 2:
+            return None
+        type_str, tail = parts
+    m = _OPCODE_RE.match(tail)
+    if m is None:
+        return None
+    depth, start = 0, m.end() - 1
+    for i in range(start, len(tail)):
+        depth += tail[i] == "("
+        depth -= tail[i] == ")"
+        if depth == 0:
+            return type_str, m.group(1), tail[start + 1: i], tail[i + 1:]
+    return None
+
+
+def _split_operands(operands: str) -> List[str]:
+    """Split an operand list on top-level commas only — shape literals
+    (``f32[8,16]{1,0}``) and nested calls carry commas of their own."""
+    out: List[str] = []
+    depth = 0
+    start = 0
+    for i, ch in enumerate(operands):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(operands[start:i].strip())
+            start = i + 1
+    tail = operands[start:].strip()
+    if tail:
+        out.append(tail)
+    return out
+
+
+def _instr_flops(
+    op: str, out_elems: int, operands: str, attrs: str,
+    shapes: Dict[str, str],
+) -> Tuple[float, float]:
+    """(flops, transcendentals) of one instruction from its shapes."""
+
+    def _operand_shape(idx: int) -> Optional[str]:
+        # operands may carry inline shapes ("f32[8,6] %x") or bare names
+        # ("%x") depending on the dump; resolve names via the module map
+        toks = _split_operands(operands)
+        if idx >= len(toks):
+            return None
+        tok = toks[idx]
+        if _SHAPE_RE.search(tok):
+            return tok
+        m = _OPERAND_RE.search(tok)
+        return shapes.get(m.group(1)) if m else None
+
+    if op in _MOVEMENT_OPS:
+        return 0.0, 0.0
+    if op == "dot":
+        k = 1
+        lhs = _operand_shape(0)
+        cd = _CONTRACT_RE.search(attrs)
+        if lhs and cd:
+            m = _SHAPE_RE.search(lhs)
+            if m:
+                dims = [
+                    int(d) for d in m.group(2).split(",") if d.strip()
+                ]
+                for ax in cd.group(1).split(","):
+                    ax = ax.strip()
+                    if ax and int(ax) < len(dims):
+                        k *= dims[int(ax)]
+        return 2.0 * k * out_elems, 0.0
+    if op == "cholesky":
+        m = _SHAPE_RE.search(_operand_shape(0) or "")
+        if m:
+            dims = [int(d) for d in m.group(2).split(",") if d.strip()]
+            if dims:
+                n = dims[-1]
+                batch = 1
+                for d in dims[:-2]:
+                    batch *= d
+                return batch * n ** 3 / 3.0, 0.0
+        return float(out_elems), 0.0
+    if op == "triangular-solve":
+        m = _SHAPE_RE.search(_operand_shape(0) or "")
+        if m:
+            dims = [int(d) for d in m.group(2).split(",") if d.strip()]
+            if len(dims) >= 2:
+                return float(dims[-1]) * out_elems, 0.0
+        return float(out_elems), 0.0
+    if op in _TRANSCENDENTAL_OPS:
+        return float(out_elems), float(out_elems)
+    if op in ("reduce", "reduce-window", "sort", "scatter",
+              "select-and-scatter"):
+        in_elems, _ = _parse_shape(_operand_shape(0) or "")
+        return float(max(in_elems, out_elems)), 0.0
+    # everything else: one flop per output element (add/multiply/select/
+    # compare/map/fusion-interface/while-interface...)
+    return float(out_elems), 0.0
+
+
+def parse_hlo_module(text: str) -> List[Dict[str, Any]]:
+    """Parse optimized-HLO text into per-instruction records:
+    ``{name, opcode, computation, out_elems, out_bytes, operand_bytes,
+    bytes, flops, transcendentals}``. Every computation in the module is
+    walked, so fusion / while / conditional bodies are counted exactly
+    once regardless of runtime trip count (module docstring caveat)."""
+    # first pass: name -> type string, for bare-name operand resolution
+    shapes: Dict[str, str] = {}
+    parsed: List[Tuple[str, str, str, str, str, str]] = []
+    computation = ""
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped in ("}", "{"):
+            continue
+        if stripped.startswith(("HloModule", "//", "#")):
+            continue
+        if stripped.endswith("{") and "=" not in stripped.split("(")[0]:
+            # "%computation.name (params) -> type {"  /  "ENTRY %main ... {"
+            m = re.search(r"%?([\w.\-]+)\s*\(", stripped)
+            computation = m.group(1) if m else ""
+            continue
+        m = _NAME_RE.match(stripped)
+        if m is None:
+            continue
+        name, rest = m.groups()
+        split = _split_instr(rest)
+        if split is None:
+            continue
+        type_str, op, operands, attrs = split
+        shapes[name] = type_str
+        parsed.append((name, computation, type_str, op, operands, attrs))
+    out: List[Dict[str, Any]] = []
+    for name, comp, type_str, op, operands, attrs in parsed:
+        try:
+            out_elems, out_bytes = _parse_shape(type_str)
+            operand_bytes = 0
+            for tok in _split_operands(operands):
+                if not tok:
+                    continue
+                if not _SHAPE_RE.search(tok):
+                    m2 = _OPERAND_RE.search(tok)
+                    tok = shapes.get(m2.group(1), "") if m2 else ""
+                operand_bytes += _parse_shape(tok)[1]
+            flops, transcendentals = _instr_flops(
+                op, out_elems, operands, attrs, shapes
+            )
+            out.append({
+                "name": name,
+                "opcode": op,
+                "computation": comp,
+                "out_elems": out_elems,
+                "out_bytes": out_bytes,
+                "operand_bytes": operand_bytes,
+                "bytes": out_bytes + operand_bytes,
+                "flops": flops,
+                "transcendentals": transcendentals,
+            })
+        except Exception:
+            continue  # one odd instruction never kills the ledger
+    return out
+
+
+def hlo_text(compiled: Any) -> Optional[str]:
+    """Optimized HLO text of a compiled executable, best-effort across
+    jax versions (``as_text()`` first, ``hlo_modules()`` fallback)."""
+    for fn in ("as_text",):
+        try:
+            t = getattr(compiled, fn)()
+            if t:
+                return t
+        except Exception:
+            pass
+    try:
+        mods = compiled.hlo_modules()
+        if mods:
+            return mods[0].to_string()
+    except Exception:
+        pass
+    return None
+
+
+def hlo_ledger(source: Any, top_k: int = 10) -> Dict[str, Any]:
+    """Per-op FLOP/byte ledger of one executable. `source` is a compiled
+    executable or raw HLO text. Returns ``by_op`` (aggregates sorted by
+    FLOPs), ``top_instructions`` (the K heaviest individual instructions
+    — the kernel target list), and module totals."""
+    text = source if isinstance(source, str) else hlo_text(source)
+    if not text:
+        return {"error": "no HLO text available", "by_op": [],
+                "top_instructions": [], "total_flops": 0.0,
+                "total_bytes": 0, "instruction_count": 0}
+    instrs = parse_hlo_module(text)
+    by_op: Dict[str, Dict[str, Any]] = {}
+    for ins in instrs:
+        agg = by_op.setdefault(
+            ins["opcode"],
+            {"opcode": ins["opcode"], "count": 0, "flops": 0.0,
+             "bytes": 0, "transcendentals": 0.0},
+        )
+        agg["count"] += 1
+        agg["flops"] += ins["flops"]
+        agg["bytes"] += ins["bytes"]
+        agg["transcendentals"] += ins["transcendentals"]
+    total_flops = sum(i["flops"] for i in instrs)
+    total_bytes = sum(i["bytes"] for i in instrs)
+    for agg in by_op.values():
+        agg["flops_share"] = (
+            agg["flops"] / total_flops if total_flops else 0.0
+        )
+    rank = sorted(
+        by_op.values(), key=lambda a: (-a["flops"], -a["bytes"])
+    )
+    top = sorted(
+        instrs, key=lambda i: (-i["flops"], -i["bytes"])
+    )[: max(0, int(top_k))]
+    return {
+        "by_op": rank,
+        "top_instructions": top,
+        "total_flops": total_flops,
+        "total_bytes": total_bytes,
+        "instruction_count": len(instrs),
+    }
+
+
+def jit_ledger(jitted: Any, *args: Any, top_k: int = 10, **kwargs: Any) -> Dict[str, Any]:
+    """Lower + compile `jitted` for these arguments and return its HLO
+    ledger. Same double-compile caveat as `compiled_cost` — opt-in only."""
+    import jax
+
+    if not hasattr(jitted, "lower"):
+        jitted = jax.jit(jitted)
+    return hlo_ledger(jitted.lower(*args, **kwargs).compile(), top_k=top_k)

@@ -50,7 +50,11 @@ from . import retrace as _retrace
 # v3: "journey" records (obs.reqtrace): per-request phase timings with
 #     W3C-style trace ids; manifest gains optional "trace_id" /
 #     "parent_span_id" lineage parsed from DISPATCHES_TPU_TRACEPARENT.
-_SCHEMA_VERSION = 3
+# v4: "compile_event" records (obs.perf): one per cold XLA compile
+#     observed by a PerfProbe — compile key/entry/bucket, elapsed
+#     seconds, cache outcome, persistent-cache config, and optional
+#     executable/code sizes + model FLOPs from AOT cost capture.
+_SCHEMA_VERSION = 4
 
 
 def _git_sha() -> Optional[str]:
@@ -320,6 +324,20 @@ class Tracer:
         segments. Emitted by `reqtrace.Journey.finish`, one per request."""
         self._emit({"kind": "journey", "ts": time.time(), **fields})
 
+    def compile_event(self, **fields: Any) -> None:
+        """Record one observed XLA compile (schema v4; see `obs.perf`):
+        compile key/entry, elapsed seconds, cache hit vs cold, and any
+        AOT-captured executable sizes. Emitted by `PerfProbe.note_compile`
+        on every cold compile (hits only when the probe opts in)."""
+        self._emit(
+            {
+                "kind": "compile_event",
+                "ts": time.time(),
+                "span": "/".join(self._stack) or None,
+                **fields,
+            }
+        )
+
     def close(self) -> None:
         """Emit a final record with cumulative retrace counts and the full
         metrics-registry snapshot, then close the file. Idempotent."""
@@ -370,6 +388,9 @@ class NullTracer:
         pass
 
     def journey(self, **fields: Any) -> None:
+        pass
+
+    def compile_event(self, **fields: Any) -> None:
         pass
 
     def close(self) -> None:

@@ -1,0 +1,339 @@
+"""Measured-performance probe (observability pillar 11).
+
+`obs.cost` answers what an executable *should* cost; this module measures
+what it *did* cost, attributed to causal phases of the chunk loop. A
+`PerfProbe` instruments `runtime.adaptive`'s chunked drivers — the
+`SlotEngine` serving loop and the three adaptive entry points — with
+host-clock boundary stamps:
+
+    SlotEngine.step():   transfer -> cold -> compute -> harvest -> host
+    _adaptive_drive():   dispatch -> compute -> harvest -> host
+
+- ``transfer`` — host->device restack of the lane mirror (`_stack()`);
+- ``cold`` / ``dispatch`` — the synchronous part of the segment call:
+  trace + lower + XLA compile on a cache miss, executable lookup on a
+  hit (execution itself is async and lands in ``compute``);
+- ``compute`` — up to the blocking done-flag/state transfer, the chunk's
+  observable compute end (same boundary `obs.reqtrace` uses);
+- ``harvest`` — the device->host solution-row transfer;
+- ``host`` — residual driver bookkeeping (retirement, compaction).
+
+**Exact-sum contract**: a chunk record's ``wall_s`` is the telescoped
+sum of its phase durations in phase order, so
+``sum(phases.values()) == wall_s`` holds *bitwise* for every chunk (it
+differs from the raw ``t_end - t0`` by float association only, a few
+ulps). tests/test_obs_perf.py asserts the equality under a fake clock.
+
+**Bitwise-neutral**: the probe reads the host clock and registry floats
+and never touches device values, so probe-on solver results are
+bitwise-identical to probe-off (asserted in tests). Off by default
+everywhere: `SlotEngine.perf` is None and the adaptive entries take
+``perf=None``, keeping the hot paths branch-free.
+
+Compile telemetry rides the same hooks: every `_note_compile` site times
+the synchronous segment call and feeds
+
+- ``compile_seconds{entry=,cache="hit"|"cold"}`` — cache-hit dispatch
+  latency vs cold trace+lower+compile latency, split by label;
+- a schema-v4 ``compile_event`` journal record per *cold* compile
+  (key, entry/bucket/kind, elapsed, whether a persistent cache dir was
+  configured, and — with ``capture_sizes=True`` — executable/code sizes
+  and model FLOPs from an AOT ``lower().compile()`` of the same
+  signature, the `obs.cost` caveat applying: that second compile is why
+  size capture is opt-in even inside the opt-in probe).
+
+Captured model FLOPs give every later chunk on that executable a
+**measured roofline point**: model FLOPs / measured chunk wall against
+the `MATMUL_PEAK.json` (measured) or `BASELINE_HOST.json` (assumed)
+anchor, published as ``perf_mxu_utilization`` / ``perf_achieved_tflops``
+gauges — which the timeseries store retains like any other gauge, so
+`fleet_top` sparklines live MXU utilization with zero extra plumbing.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import metrics as obs_metrics
+from .cost import chip_peak_tflops, cost_from_compiled
+
+# Sub-millisecond..seconds ladder: chunk phases live below the default
+# bucket ladder's useful resolution (same shape as reqtrace.PHASE_BUCKETS
+# with a compile-scale tail — cold XLA compiles reach tens of seconds).
+PERF_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+obs_metrics.describe(
+    "perf_chunk_seconds",
+    "Measured chunk wall time per adaptive entry (PerfProbe).",
+)
+obs_metrics.describe(
+    "perf_phase_seconds",
+    "Measured chunk time attributed to one phase (transfer/cold/dispatch/"
+    "compute/harvest/host), per adaptive entry.",
+)
+obs_metrics.describe(
+    "compile_seconds",
+    "Synchronous segment-call latency split by executable-cache outcome: "
+    "cold = trace+lower+XLA compile, hit = dispatch/lookup only.",
+)
+obs_metrics.describe(
+    "perf_chunks_total", "Chunks measured by the PerfProbe, per entry.",
+)
+obs_metrics.describe(
+    "perf_model_flops_total",
+    "Model FLOPs (XLA cost analysis) executed by measured chunks.",
+)
+obs_metrics.describe(
+    "perf_mxu_utilization",
+    "Last measured-roofline utilization per entry: model FLOPs / measured "
+    "chunk wall vs the chip peak anchor.",
+)
+obs_metrics.describe(
+    "perf_achieved_tflops",
+    "Last measured achieved TFLOP/s per entry (model FLOPs / chunk wall).",
+)
+
+
+def _key_str(key: Any) -> str:
+    """Stable JSON-safe rendering of a compile-accounting key tuple."""
+    try:
+        return repr(tuple(key))
+    except Exception:
+        return repr(key)
+
+
+class _Chunk:
+    """One in-flight chunk measurement: boundary marks in causal order,
+    finished exactly once by `done()`. `mark(name)` closes the phase
+    `name` at the current (or given) clock stamp; repeated marks of the
+    same name extend it (a chunk never re-enters an earlier phase)."""
+
+    __slots__ = ("probe", "entry", "t0", "marks", "flops", "_done")
+
+    def __init__(self, probe: "PerfProbe", entry: str, t0: float):
+        self.probe = probe
+        self.entry = entry
+        self.t0 = float(t0)
+        self.marks: List[Tuple[str, float]] = []
+        self.flops: Optional[float] = None
+        self._done = False
+
+    def mark(self, phase: str, t: Optional[float] = None) -> None:
+        t = self.probe.clock() if t is None else float(t)
+        self.marks.append((str(phase), t))
+
+    def add_flops(self, flops: Optional[float]) -> None:
+        """Accumulate the model-FLOP cost of one executable run of this
+        chunk (None = unknown cost, ignored)."""
+        if flops is not None:
+            self.flops = (self.flops or 0.0) + float(flops)
+
+    def done(self, **extra: Any) -> Optional[Dict[str, Any]]:
+        """Close the chunk: derive phase durations, feed the histograms
+        and roofline gauges, return (and ring-retain) the record.
+        Idempotent — the drivers finalize from two exit paths."""
+        if self._done:
+            return None
+        self._done = True
+        return self.probe._finish_chunk(self, extra)
+
+
+class PerfProbe:
+    """Opt-in measured-phase instrument for the chunked solve drivers.
+
+    Attach as ``engine.perf = PerfProbe()`` or pass ``perf=probe`` to the
+    adaptive entry points. `clock` is injectable (fake clocks in tests);
+    it must match the service clock when phases should line up with
+    request journeys. ``capture_sizes=True`` additionally AOT-compiles
+    each cold executable to harvest `obs.cost` sizes + model FLOPs
+    (doubling compile work — see module docstring).
+    """
+
+    def __init__(
+        self,
+        *,
+        clock=time.perf_counter,
+        capture_sizes: bool = False,
+        journal_hits: bool = False,
+        peak_tflops: Optional[float] = None,
+        repo_root: Optional[str] = None,
+        max_records: int = 256,
+    ):
+        self.clock = clock
+        self.capture_sizes = bool(capture_sizes)
+        self.journal_hits = bool(journal_hits)
+        if peak_tflops is not None:
+            self.peak_tflops: Optional[float] = float(peak_tflops)
+            self.peak_source = "explicit"
+        else:
+            self.peak_tflops, self.peak_source = chip_peak_tflops(repo_root)
+        self.max_records = int(max_records)
+        self.records: List[Dict[str, Any]] = []  # recent chunk records
+        self.compile_records: List[Dict[str, Any]] = []
+        self.chunks = 0
+        self.compiles = {"hit": 0, "cold": 0}
+        self._flops: Dict[Any, float] = {}  # compile key -> model flops
+        self._model_flops: Dict[str, float] = {}  # entry-level fallback
+
+    # -- chunk lifecycle ----------------------------------------------
+    def chunk(self, entry: str) -> _Chunk:
+        """Open a chunk measurement at the current clock stamp."""
+        return _Chunk(self, entry, self.clock())
+
+    def set_model_flops(self, entry: str, flops: float) -> None:
+        """Entry-level model-FLOP anchor for rooflines when AOT size
+        capture is off (e.g. from a one-time `obs.cost` probe)."""
+        self._model_flops[str(entry)] = float(flops)
+
+    def flops_for(self, key: Any, entry: Optional[str] = None) -> Optional[float]:
+        """Model FLOPs of one run of the executable behind `key` (from a
+        `capture_sizes` cold compile), else the entry-level anchor."""
+        v = self._flops.get(key)
+        if v is None and entry is not None:
+            v = self._model_flops.get(str(entry))
+        return v
+
+    def _finish_chunk(
+        self, chunk: _Chunk, extra: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        t_end = self.clock()
+        phases: Dict[str, float] = {}
+        prev = chunk.t0
+        for name, t in chunk.marks:
+            phases[name] = phases.get(name, 0.0) + (t - prev)
+            prev = t
+        phases["host"] = t_end - prev
+        # wall is the telescoped phase sum, in insertion order — the
+        # exact-sum contract of the module docstring
+        wall = sum(phases.values())
+        entry = chunk.entry
+        for name, dur in phases.items():
+            obs_metrics.observe(
+                "perf_phase_seconds", dur, buckets=PERF_BUCKETS,
+                entry=entry, phase=name,
+            )
+        obs_metrics.observe(
+            "perf_chunk_seconds", wall, buckets=PERF_BUCKETS, entry=entry
+        )
+        obs_metrics.inc("perf_chunks_total", entry=entry)
+        rec: Dict[str, Any] = {
+            "entry": entry, "wall_s": wall, "phases": phases, **extra,
+        }
+        if chunk.flops is not None:
+            rec["flops"] = chunk.flops
+            obs_metrics.inc(
+                "perf_model_flops_total", chunk.flops, entry=entry
+            )
+            if wall > 0:
+                achieved = chunk.flops / wall / 1e12
+                rec["achieved_tflops"] = achieved
+                obs_metrics.set_gauge(
+                    "perf_achieved_tflops", achieved, entry=entry
+                )
+                if self.peak_tflops:
+                    rec["utilization"] = achieved / self.peak_tflops
+                    rec["peak_source"] = self.peak_source
+                    obs_metrics.set_gauge(
+                        "perf_mxu_utilization", rec["utilization"],
+                        entry=entry,
+                    )
+        self.chunks += 1
+        self.records.append(rec)
+        del self.records[: -self.max_records]
+        return rec
+
+    # -- compile telemetry --------------------------------------------
+    def note_compile(
+        self,
+        entry: str,
+        key: Any,
+        hit: bool,
+        elapsed_s: float,
+        *,
+        kind: Optional[str] = None,
+        fn: Any = None,
+        args: Tuple = (),
+    ) -> Dict[str, Any]:
+        """Record one timed segment call: the `compile_seconds` histogram
+        split hit/cold, a ``compile_event`` journal record per cold
+        compile (per hit too with ``journal_hits=True``), and — on cold
+        with ``capture_sizes`` — the AOT cost/size capture whose FLOPs
+        anchor later rooflines for this executable."""
+        cache = "hit" if hit else "cold"
+        self.compiles[cache] += 1
+        obs_metrics.observe(
+            "compile_seconds", float(elapsed_s), buckets=PERF_BUCKETS,
+            entry=entry, cache=cache,
+        )
+        rec: Dict[str, Any] = {
+            "entry": entry,
+            "key": _key_str(key),
+            "cache": cache,
+            "elapsed_s": float(elapsed_s),
+        }
+        if kind is not None:
+            # "compile_kind", not "kind": the journal record's own kind
+            # field is "compile_event" and **fields must not clobber it
+            rec["compile_kind"] = str(kind)
+        try:
+            bucket = key[1]
+            if isinstance(bucket, int):
+                rec["bucket"] = bucket
+        except Exception:
+            pass
+        if not hit:
+            rec["persistent_cache"] = self._persistent_cache_dir()
+            if self.capture_sizes and fn is not None:
+                rec.update(self._capture_cost(key, fn, args))
+        if not hit or self.journal_hits:
+            from .journal import get_tracer  # lazy, mirrors reqtrace
+
+            get_tracer().compile_event(**rec)
+        self.compile_records.append(rec)
+        del self.compile_records[: -self.max_records]
+        return rec
+
+    @staticmethod
+    def _persistent_cache_dir() -> Optional[str]:
+        try:
+            import jax
+
+            return jax.config.jax_compilation_cache_dir or None
+        except Exception:
+            return None
+
+    def _capture_cost(self, key: Any, fn: Any, args: Tuple) -> Dict[str, Any]:
+        """AOT ``lower().compile()`` of the segment's signature for
+        `obs.cost` sizes and model FLOPs. Best-effort: any failure lands
+        as a ``cost_error`` string, never an exception — telemetry must
+        not kill the solve it measures."""
+        try:
+            import jax
+
+            jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+            cost = cost_from_compiled(jitted.lower(*args).compile())
+        except Exception as e:
+            return {"cost_error": f"{type(e).__name__}: {e}"}
+        if "flops" in cost:
+            self._flops[key] = float(cost["flops"])
+        return {
+            k: v for k, v in cost.items()
+            if k in (
+                "flops", "bytes_accessed", "transcendentals",
+                "generated_code_bytes", "argument_bytes", "output_bytes",
+                "temp_bytes", "peak_bytes",
+            )
+        }
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "chunks": self.chunks,
+            "compiles": dict(self.compiles),
+            "peak_tflops": self.peak_tflops,
+            "peak_source": self.peak_source,
+            "executables_costed": len(self._flops),
+        }

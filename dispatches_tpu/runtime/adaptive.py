@@ -171,6 +171,7 @@ def _adaptive_drive(
     trace: bool,
     stats: Optional[dict],
     opt_key,
+    perf=None,
 ):
     """Host-side retirement/compaction loop shared by the dense, banded,
     and PDHG adaptive entry points.
@@ -182,7 +183,12 @@ def _adaptive_drive(
     marks finished lanes (converged/broke down, or out of iteration
     budget). Lane data rows are gathered per `axes` (one in-axis spec per
     `fields_cls` field; None = broadcast). Returns ``(solution rows
-    stacked in original order, stitched traces or None)``."""
+    stacked in original order, stitched traces or None)``.
+
+    `perf` (an `obs.perf.PerfProbe`, default None = branch-free) measures
+    each chunk's dispatch / compute / harvest phases and times every
+    segment call for compile telemetry; it reads only the host clock, so
+    probe-on results are bitwise probe-off (tests/test_obs_perf.py)."""
     import jax.numpy as jnp
 
     data_np = [np.asarray(a) if ax == 0 else a for a, ax in zip(data, axes)]
@@ -215,18 +221,40 @@ def _adaptive_drive(
     while True:
         it_stop += chunk_iters
         stop = jnp.asarray(min(it_stop, max_iter))
-        if _note_compile((entry, bucket, st_cur is not None, trace, opt_key)):
+        resume = st_cur is not None
+        key = (entry, bucket, resume, trace, opt_key)
+        hit = _note_compile(key)
+        if hit:
             compile_hits += 1
         else:
             compile_misses += 1
-        if st_cur is None:
-            sol, st = seg_cold(d_cur, w_cur, stop)
-        else:
+        pc = perf.chunk(entry) if perf is not None else None
+        if resume:
             sol, st = seg_resume(d_cur, st_cur, stop)
+        else:
+            sol, st = seg_cold(d_cur, w_cur, stop)
+        if pc is not None:
+            # the synchronous part of the segment call: dispatch on a
+            # hit, trace+lower+XLA compile on a miss
+            perf.note_compile(
+                entry, key, hit, perf.clock() - pc.t0,
+                kind="resume" if resume else "cold",
+                fn=seg_resume if resume else seg_cold,
+                args=(d_cur, st_cur, stop) if resume
+                else (d_cur, w_cur, stop),
+            )
+            pc.add_flops(perf.flops_for(key, entry))
+            pc.mark("dispatch")
         chunks += 1
         buckets_used.append(bucket)
         st_np = _np_tree(st)
+        if pc is not None:
+            # the state transfer is where async dispatch blocks: the
+            # chunk's observable compute end
+            pc.mark("compute")
         sol_np = _np_tree(sol)
+        if pc is not None:
+            pc.mark("harvest")
         finished = retired_flag(st_np)
 
         still = []  # (row in current batch, original lane)
@@ -244,6 +272,8 @@ def _adaptive_drive(
         newly = len(active) - len(still)
         active = [lane for _, lane in still]
         if not active:
+            if pc is not None:
+                pc.done(bucket=bucket, chunk=chunks)
             break
         # lanes that stopped consuming device time while the batch runs on
         lanes_retired += newly
@@ -262,6 +292,11 @@ def _adaptive_drive(
             ))
             bucket = new_bucket
         st_cur = _jnp_tree(st_np)
+        if pc is not None:
+            # retirement bookkeeping + compaction land in the "host"
+            # residual phase; buckets_used[-1] is the bucket this chunk
+            # actually ran at (compaction may just have shrunk `bucket`)
+            pc.done(bucket=buckets_used[-1], chunk=chunks)
 
     if lanes_retired:
         obs_metrics.inc(
@@ -576,6 +611,10 @@ class SlotEngine:
         # that harvest unhealthy re-solve up the escalation ladder before
         # the caller sees them. None keeps the harvest untouched.
         self.remedy = None
+        # optional measured-performance probe (obs.perf.PerfProbe): phase-
+        # attributed chunk timings + compile telemetry. Host clocks only;
+        # None keeps the hot path branch-free.
+        self.perf = None
 
     # -- slot management ----------------------------------------------
     def free_slots(self) -> int:
@@ -750,20 +789,34 @@ class SlotEngine:
         if not any(t is not None for t in self._tokens):
             return []
         watch = self.observer
+        perf = self.perf
+        pc = perf.chunk(self.entry) if perf is not None else None
         if watch is not None:
             watch.chunk_begin(self._tokens)
         if self._dirty:
             self._d_cur = self._stack()
             self._dirty = False
+            if pc is not None:
+                # host->device restack of the lane mirror; chunks with a
+                # clean mirror skip the phase entirely
+                pc.mark("transfer")
         occupied = np.asarray([t is not None for t in self._tokens])
 
         if any(self._fresh):
-            _note_compile((self.entry, self.bucket, "cold", self.trace,
-                           self.opt_key))
+            key_c = (self.entry, self.bucket, "cold", self.trace,
+                     self.opt_key)
+            hit_c = _note_compile(key_c)
             if self._zero_stops is None:
                 self._zero_stops = jnp.zeros((self.bucket,), jnp.int32)
             w_arg = self._warm_seeds() if self._warm_fn is not None else None
+            t0c = perf.clock() if pc is not None else None
             _, st0 = self.seg_cold(self._d_cur, w_arg, self._zero_stops)
+            if pc is not None:
+                perf.note_compile(
+                    self.entry, key_c, hit_c, perf.clock() - t0c,
+                    kind="cold", fn=self.seg_cold,
+                    args=(self._d_cur, w_arg, self._zero_stops),
+                )
             # the very first chunk routes through the same scatter as
             # every later one (sel = all rows), so the carried tree's
             # avals never change and resume compiles exactly once
@@ -775,6 +828,10 @@ class SlotEngine:
             self._st = self._scatter()(base, st0, sel)
             if watch is not None:
                 watch.cold_end(self._tokens, self._fresh)
+            if pc is not None:
+                # zero-stop dispatch + fresh-row scatter (model FLOPs are
+                # NOT credited here: the cold executable runs 0 iterations)
+                pc.mark("cold")
             self._fresh = [False] * self.bucket
 
         # stops come from the host iteration marks, not a device read:
@@ -786,9 +843,19 @@ class SlotEngine:
             np.minimum(self._it_mark + self.chunk_iters, self.max_iter),
             0,
         ).astype(np.int32)
-        _note_compile((self.entry, self.bucket, "resume", self.trace,
-                       self.opt_key))
-        sol, st = self.seg_resume(self._d_cur, self._st, jnp.asarray(stops))
+        key_r = (self.entry, self.bucket, "resume", self.trace,
+                 self.opt_key)
+        hit_r = _note_compile(key_r)
+        stops_dev = jnp.asarray(stops)
+        t0r = perf.clock() if pc is not None else None
+        sol, st = self.seg_resume(self._d_cur, self._st, stops_dev)
+        if pc is not None:
+            perf.note_compile(
+                self.entry, key_r, hit_r, perf.clock() - t0r,
+                kind="resume", fn=self.seg_resume,
+                args=(self._d_cur, self._st, stops_dev),
+            )
+            pc.add_flops(perf.flops_for(key_r, self.entry))
         self._st = st
         self._it_mark = stops
         self.chunks += 1
@@ -805,6 +872,8 @@ class SlotEngine:
             # the np.asarray above is where async dispatch blocks, so this
             # stamp is the chunk's observable compute end
             watch.compute_end(self._tokens, it_before, stops)
+        if pc is not None:
+            pc.mark("compute")
 
         out = []
         retired = 0
@@ -850,6 +919,10 @@ class SlotEngine:
             if watch is not None:
                 # after the _sol_rows() harvest transfer completed
                 watch.harvest_end([tok for tok, _, _ in out])
+            if pc is not None:
+                pc.mark("harvest")
+        if pc is not None:
+            pc.done(bucket=self.bucket, chunk=self.chunks, retired=retired)
         return out
 
 
@@ -978,6 +1051,7 @@ def solve_lp_adaptive(
     trace: bool = False,
     stats: Optional[dict] = None,
     remedy=None,
+    perf=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.ipm.solve_lp_batch`: identical
@@ -1001,7 +1075,10 @@ def solve_lp_adaptive(
     runs the verdict-driven escalation ladder on lanes that retire
     unhealthy, substituting recovered rows in place
     (``stats["remediated"]`` records per-lane outcomes). Default None is
-    bitwise-identical to the historical path."""
+    bitwise-identical to the historical path.
+
+    `perf` (an `obs.perf.PerfProbe`) measures per-chunk phase timings and
+    compile latency; host-clock-only, so probe-on is bitwise probe-off."""
     import jax
 
     from ..core.program import LPData
@@ -1052,7 +1129,7 @@ def solve_lp_adaptive(
         IPMSolution,
         lambda st: np.asarray(st.done) | (np.asarray(st.it) >= max_iter),
         max_iter, chunk_iters, bucket_ladder(batch, ladder_base),
-        warm_start, trace, stats, _opt_key(solver_kw),
+        warm_start, trace, stats, _opt_key(solver_kw), perf,
     )
     if remedy is not None:
         out, tr = _apply_remedy(
@@ -1072,12 +1149,14 @@ def solve_lp_banded_adaptive(
     trace: bool = False,
     stats: Optional[dict] = None,
     remedy=None,
+    perf=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.structured.solve_lp_banded_batch`
     (same contract as `solve_lp_adaptive`, including `warm_predictor`
-    seeding with cold-path fallback and the `remedy` escalation ladder on
-    unhealthy lanes; the year-scenario path)."""
+    seeding with cold-path fallback, the `remedy` escalation ladder on
+    unhealthy lanes, and the `perf` measurement probe; the year-scenario
+    path)."""
     import jax
 
     from ..solvers.ipm import IPMSolution
@@ -1140,7 +1219,7 @@ def solve_lp_banded_adaptive(
         IPMSolution,
         lambda st: np.asarray(st.done) | (np.asarray(st.it) >= max_iter),
         max_iter, chunk_iters, bucket_ladder(batch, ladder_base),
-        warm_start, trace, stats, _opt_key(solver_kw),
+        warm_start, trace, stats, _opt_key(solver_kw), perf,
     )
     if remedy is not None:
         out, tr = _apply_remedy(
@@ -1160,6 +1239,7 @@ def solve_lp_pdhg_adaptive(
     trace: bool = False,
     stats: Optional[dict] = None,
     remedy=None,
+    perf=None,
     **solver_kw,
 ):
     """Adaptive-batch PDHG over a batch of `SparseLP`s sharing one
@@ -1238,7 +1318,7 @@ def solve_lp_pdhg_adaptive(
         PDHGSolution,
         lambda st: np.asarray(st.done) | (np.asarray(st.it) >= max_iter),
         max_iter, chunk_iters, bucket_ladder(batch, ladder_base),
-        warm_start, trace, stats, _opt_key(solver_kw),
+        warm_start, trace, stats, _opt_key(solver_kw), perf,
     )
     if remedy is not None:
         out, tr = _apply_remedy(

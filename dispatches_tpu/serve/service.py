@@ -520,6 +520,7 @@ def make_dense_service(
     trace: bool = False,
     reqtrace: bool = False,
     timeseries: bool = False,
+    perf: bool = False,
     warm_model=None,
     remedy=None,
     **solver_kw,
@@ -543,7 +544,13 @@ def make_dense_service(
     `timeseries=True` (default False = no retention, bitwise-identical)
     attaches an `obs.timeseries.SeriesStore` on the service clock and
     samples it from `pump()`, so ``service.store.query(...)`` answers
-    over history (docs/observability.md §10)."""
+    over history (docs/observability.md §10).
+
+    `perf=True` (default False = unmeasured, bitwise-identical) attaches
+    an `obs.perf.PerfProbe` as ``engine.perf``: every chunk gets
+    phase-attributed wall time, compile hit/cold telemetry, and — with
+    `timeseries=True` too — a live ``perf_mxu_utilization`` window
+    (docs/observability.md §11)."""
     from ..runtime.adaptive import make_dense_engine
 
     remedy_engine = None
@@ -559,6 +566,12 @@ def make_dense_service(
         bucket, chunk_iters=chunk_iters, trace=trace,
         warm_predictor=warm_model, remedy=remedy_engine, **solver_kw
     )
+    if perf:
+        from ..obs.perf import PerfProbe
+
+        # on the service clock: deadlines, journeys, and phase times all
+        # read the same timebase (and a fake clock drives all three)
+        engine.perf = PerfProbe(clock=clock)
     cache = ResultCache(cache_size) if cache_size else None
     store = None
     if timeseries:

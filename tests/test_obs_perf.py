@@ -1,0 +1,402 @@
+"""Performance observatory (docs/observability.md §11): the PerfProbe's
+bitwise-neutrality and exact phase-sum contracts, compile hit/cold
+telemetry + schema-v4 ``compile_event`` journaling, measured-roofline
+gauges, the benchstore trend gate, the HLO op ledger, and
+trace_summary's perf/compile rendering with mixed-schema degradation."""
+import importlib
+import io
+import json
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.obs import benchstore
+from dispatches_tpu.obs.cost import parse_hlo_module
+from dispatches_tpu.obs.journal import Tracer, use_tracer
+from dispatches_tpu.obs.metrics import get_registry, reset_metrics
+from dispatches_tpu.obs.perf import PerfProbe
+from dispatches_tpu.runtime.adaptive import solve_lp_adaptive
+from dispatches_tpu.serve import make_dense_service
+
+
+def _lp(seed, n=6, m=3, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    return LPData(
+        jnp.asarray(A, dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _lp_batch(rows=5, **kw):
+    return LPData(*(jnp.stack(leaves)
+                    for leaves in zip(*[_lp(i, **kw) for i in range(rows)])))
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+class TickClock:
+    """Deterministic clock whose increments (multiples of 0.1) are NOT
+    exactly representable in binary — so `t_end - t0` genuinely differs
+    from the telescoped phase sum by association, and the exact-sum
+    assertion below is meaningful, not vacuous."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.k = 0
+
+    def __call__(self):
+        self.k += 1
+        self.t += 0.1 * self.k
+        return self.t
+
+
+# unique per-test solver kwargs => unique `_opt_key` => the process-global
+# `_COMPILE_SEEN` set treats each test's first chunk as genuinely cold,
+# regardless of what other tests compiled before
+def _fresh_kw(tag: float):
+    return dict(max_iter=30, chunk_iters=4, tol=1e-8 * (1.0 + tag))
+
+
+# ---------------------------------------------------------------------
+# bitwise neutrality: probe-on results == probe-off results
+# ---------------------------------------------------------------------
+class TestBitwiseNeutral:
+    def test_adaptive_entry_probe_on_is_bitwise_off(self):
+        lp = _lp_batch(5)
+        kw = _fresh_kw(0.111)
+        sol_off = solve_lp_adaptive(lp, **kw)
+        probe = PerfProbe(peak_tflops=100.0)
+        sol_on = solve_lp_adaptive(lp, perf=probe, **kw)
+        for a, b in zip(sol_off, sol_on):
+            assert _biteq(a, b)
+        assert probe.chunks > 0
+        assert probe.compiles["cold"] + probe.compiles["hit"] == probe.chunks
+
+    def test_slot_engine_probe_on_is_bitwise_off(self):
+        def run(perf):
+            svc = make_dense_service(
+                2, chunk_iters=4, cache_size=None, perf=perf, max_iter=40
+            )
+            tickets = {i: svc.submit(_lp(i), request_id=f"r{i}")
+                       for i in range(4)}
+            while any(not t.done() for t in tickets.values()):
+                svc.pump()
+            return {i: t.result(timeout=0) for i, t in tickets.items()}, svc
+
+        off, _ = run(False)
+        on, svc = run(True)
+        probe = svc.engine.perf
+        assert isinstance(probe, PerfProbe) and probe.chunks > 0
+        for i in off:
+            for a, b in zip(off[i].solution, on[i].solution):
+                assert _biteq(a, b)
+
+
+# ---------------------------------------------------------------------
+# the exact phase-sum contract under a fake clock
+# ---------------------------------------------------------------------
+class TestPhaseSum:
+    def test_wall_is_bitwise_phase_sum(self):
+        probe = PerfProbe(clock=TickClock(), peak_tflops=1.0)
+        pc = probe.chunk("e")
+        pc.mark("transfer")
+        pc.mark("compute")
+        pc.mark("compute")  # repeated marks extend the same phase
+        pc.mark("harvest")
+        rec = pc.done(bucket=4)
+        assert rec["wall_s"] == sum(rec["phases"].values())  # bitwise
+        assert set(rec["phases"]) == {"transfer", "compute", "harvest",
+                                      "host"}
+        assert all(d >= 0.0 for d in rec["phases"].values())
+        assert rec["bucket"] == 4
+
+    def test_done_is_idempotent(self):
+        probe = PerfProbe(clock=TickClock(), peak_tflops=1.0)
+        pc = probe.chunk("e")
+        pc.mark("compute")
+        assert pc.done() is not None
+        assert pc.done() is None
+        assert probe.chunks == 1
+
+    def test_engine_chunks_hold_the_contract(self):
+        lp = _lp_batch(5)
+        probe = PerfProbe(peak_tflops=100.0)
+        solve_lp_adaptive(lp, perf=probe, **_fresh_kw(0.222))
+        assert probe.records
+        for rec in probe.records:
+            assert rec["wall_s"] == sum(rec["phases"].values())
+            assert "host" in rec["phases"]
+
+
+# ---------------------------------------------------------------------
+# compile telemetry: hit/cold split + schema-v4 journal records
+# ---------------------------------------------------------------------
+class TestCompileTelemetry:
+    # chunk_iters=1 guarantees several chunks at the initial bucket
+    # before any lane converges: chunk 1 sees the cold key first (cold),
+    # chunk 2 the resume key first (cold), chunks 3+ hit the resume key.
+    # Compaction to a smaller bucket can add further colds, so counts
+    # assert >= where compaction may interleave.
+    def test_cold_then_hits_and_journal_records(self):
+        lp = _lp_batch(5)
+        probe = PerfProbe(peak_tflops=100.0)
+        with use_tracer(Tracer(None)) as tr:
+            solve_lp_adaptive(
+                lp, perf=probe, max_iter=30, chunk_iters=1, tol=1.333e-8
+            )
+        assert probe.compiles["cold"] >= 2
+        assert probe.compiles["hit"] >= 1
+        evs = [e for e in tr.events if e.get("kind") == "compile_event"]
+        assert len(evs) == probe.compiles["cold"]  # hits not journaled
+        assert all(e["cache"] == "cold" for e in evs)
+        assert all(e["entry"] == "solve_lp" for e in evs)
+        assert all(e["elapsed_s"] >= 0.0 for e in evs)
+        # the record's journal kind survives the field spread; the
+        # cold/resume distinction rides in compile_kind
+        assert {e["compile_kind"] for e in evs} >= {"cold", "resume"}
+        assert all(isinstance(e.get("bucket"), int) for e in evs)
+
+    def test_journal_hits_opt_in(self):
+        lp = _lp_batch(5)
+        probe = PerfProbe(peak_tflops=100.0, journal_hits=True)
+        with use_tracer(Tracer(None)) as tr:
+            solve_lp_adaptive(
+                lp, perf=probe, max_iter=30, chunk_iters=1, tol=1.444e-8
+            )
+        evs = [e for e in tr.events if e.get("kind") == "compile_event"]
+        assert sum(1 for e in evs if e["cache"] == "cold") >= 2
+        assert sum(1 for e in evs if e["cache"] == "hit") >= 1
+
+    def test_compile_seconds_histogram_split(self):
+        reset_metrics()
+        lp = _lp_batch(5)
+        probe = PerfProbe(peak_tflops=100.0)
+        solve_lp_adaptive(
+            lp, perf=probe, max_iter=30, chunk_iters=1, tol=1.555e-8
+        )
+        hists = get_registry().snapshot()["histograms"]
+        cold = [s for s in hists if s.startswith("compile_seconds")
+                and 'cache="cold"' in s]
+        hit = [s for s in hists if s.startswith("compile_seconds")
+               and 'cache="hit"' in s]
+        assert cold and hit
+        assert sum(hists[s]["count"] for s in cold) == probe.compiles["cold"]
+        assert sum(hists[s]["count"] for s in hit) == probe.compiles["hit"]
+
+
+# ---------------------------------------------------------------------
+# measured roofline: model FLOPs / measured wall vs the peak anchor
+# ---------------------------------------------------------------------
+class TestRoofline:
+    def test_utilization_gauge_from_entry_anchor(self):
+        reset_metrics()
+        probe = PerfProbe(clock=TickClock(), peak_tflops=2.0)
+        assert probe.peak_source == "explicit"
+        probe.set_model_flops("e", 1e9)
+        pc = probe.chunk("e")
+        pc.add_flops(probe.flops_for(("unknown-key",), "e"))
+        pc.add_flops(probe.flops_for(("unknown-key",), "e"))
+        pc.mark("compute")
+        rec = pc.done()
+        assert rec["flops"] == 2e9
+        assert rec["achieved_tflops"] == pytest.approx(
+            2e9 / rec["wall_s"] / 1e12
+        )
+        assert rec["utilization"] == pytest.approx(
+            rec["achieved_tflops"] / 2.0
+        )
+        gauges = get_registry().snapshot()["gauges"]
+        assert any(s.startswith("perf_mxu_utilization") and 'entry="e"' in s
+                   for s in gauges)
+
+    def test_unknown_flops_keep_record_timing_only(self):
+        probe = PerfProbe(clock=TickClock(), peak_tflops=2.0)
+        pc = probe.chunk("e")
+        pc.add_flops(None)  # unknown cost: no roofline, no crash
+        pc.mark("compute")
+        rec = pc.done()
+        assert "flops" not in rec and "utilization" not in rec
+
+
+# ---------------------------------------------------------------------
+# benchstore: MAD trend gate
+# ---------------------------------------------------------------------
+class TestBenchstore:
+    def _hist(self):
+        return [
+            {"ts": float(i), "label": "bench",
+             "fingerprint": {"device_kind": "TPU v4"},
+             "metrics": {"wall_s": 1.0 + 0.02 * (i % 3 - 1),
+                         "goodput_rps": 120.0 + (i % 2)}}
+            for i in range(8)
+        ]
+
+    def _entry(self, **metrics):
+        return {"ts": 99.0, "label": "bench",
+                "fingerprint": {"device_kind": "TPU v4"},
+                "metrics": metrics}
+
+    def test_injected_regression_flagged(self):
+        g = benchstore.trend_gate(
+            self._hist(), self._entry(wall_s=1.6, goodput_rps=120.0)
+        )
+        assert not g["ok"]
+        assert [r["metric"] for r in g["regressions"]] == ["wall_s"]
+
+    def test_jitter_passes(self):
+        g = benchstore.trend_gate(
+            self._hist(), self._entry(wall_s=1.01, goodput_rps=120.5)
+        )
+        assert g["ok"]
+
+    def test_direction_injection(self):
+        jd = importlib.import_module("tools.journal_diff")
+        g = benchstore.trend_gate(
+            self._hist(), self._entry(wall_s=1.0, goodput_rps=60.0),
+            lower_is_better=jd.lower_is_better,
+        )
+        assert [r["metric"] for r in g["regressions"]] == ["goodput_rps"]
+
+    def test_device_kind_fence(self):
+        cpu = {"ts": 99.0, "label": "bench",
+               "fingerprint": {"device_kind": None},
+               "metrics": {"wall_s": 9.0}}
+        g = benchstore.trend_gate(self._hist(), cpu)
+        assert g["baseline_n"] == 0 and g["ok"]
+        assert g["rows"][0]["verdict"] == "new"
+
+    def test_round_trip_with_torn_tail(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        for h in self._hist():
+            benchstore.append_entry(path, h)
+        with open(path, "a") as fh:
+            fh.write('{"torn": ')
+        back = benchstore.read_history(path)
+        assert len(back) == 8
+        assert benchstore.trend_gate(
+            back, self._entry(wall_s=1.0, goodput_rps=120.0)
+        )["ok"]
+
+
+# ---------------------------------------------------------------------
+# HLO op ledger (obs.cost)
+# ---------------------------------------------------------------------
+_HLO = """\
+HloModule tiny
+
+ENTRY main {
+  p0 = f32[8,16]{1,0} parameter(0)
+  p1 = f32[16,64]{1,0} parameter(1)
+  d = f32[8,64]{1,0} dot(f32[8,16]{1,0} p0, f32[16,64]{1,0} p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  e = f32[8,64]{1,0} exponential(d)
+  t = f32[64,8]{1,0} transpose(e), dimensions={1,0}
+  ROOT r = f32[64,8]{1,0} add(t, t)
+}
+"""
+
+
+class TestHLOLedger:
+    def test_static_flops_and_movement(self):
+        instrs = parse_hlo_module(_HLO)
+        by = {i["name"]: i for i in instrs}
+        assert by["d"]["flops"] == 2 * 16 * 8 * 64  # 2*K*out_elems
+        assert by["e"]["transcendentals"] == 8 * 64
+        assert by["t"]["flops"] == 0  # movement is free in the ledger
+        assert by["r"]["flops"] == 8 * 64
+        assert by["p0"]["out_bytes"] == 4 * 8 * 16
+
+    def test_jit_ledger_ranks_the_dot(self):
+        from dispatches_tpu.obs.cost import jit_ledger
+
+        led = jit_ledger(
+            lambda a, b: jnp.tanh(a @ b),
+            jnp.ones((16, 32), jnp.float32),
+            jnp.ones((32, 48), jnp.float32),
+        )
+        assert "error" not in led
+        assert led["total_flops"] > 0
+        ops = [row["opcode"] for row in led["by_op"]]
+        assert any("dot" in op or "fusion" in op for op in ops)
+
+
+# ---------------------------------------------------------------------
+# trace_summary: compile footer + perf columns, mixed-schema degradation
+# ---------------------------------------------------------------------
+def _base_journal():
+    return [
+        {"kind": "manifest", "run_id": "r1", "schema_version": 4,
+         "git_sha": "cafe", "device_kind": "cpu", "device_count": 1},
+        {"kind": "span_start", "span": "solve", "ts": 0.0, "mono": 0.0},
+        {"kind": "span_end", "span": "solve", "ok": True, "wall_s": 0.5},
+    ]
+
+
+def _close(hists):
+    return {"kind": "close", "retrace_totals": {},
+            "metrics": {"counters": {}, "gauges": {}, "histograms": hists}}
+
+
+def _render(tmp_path, records):
+    ts = importlib.import_module("tools.trace_summary")
+    p = tmp_path / "j.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    out = io.StringIO()
+    rc = ts.main([str(p)], out=out)
+    return rc, out.getvalue()
+
+
+class TestTraceSummaryPerf:
+    def test_compile_footer_and_perf_columns(self, tmp_path):
+        hist = {"count": 2, "sum": 0.3,
+                "buckets": {"0.1": 1, "0.25": 1, "+Inf": 0}}
+        recs = _base_journal() + [
+            {"kind": "compile_event", "entry": "solve_lp", "cache": "cold",
+             "elapsed_s": 1.75, "compile_kind": "cold", "bucket": 8,
+             "generated_code_bytes": 4096},
+            {"kind": "compile_event", "entry": "solve_lp", "cache": "cold",
+             "elapsed_s": 0.5},
+            {"kind": "compile_event", "entry": "solve_lp", "cache": "hit",
+             "elapsed_s": 0.002},
+            _close({
+                'perf_chunk_seconds{entry="solve_lp"}': hist,
+                'perf_phase_seconds{entry="solve_lp",phase="compute"}': hist,
+                'compile_seconds{cache="cold",entry="solve_lp"}': hist,
+            }),
+        ]
+        rc, txt = _render(tmp_path, recs)
+        assert rc == 0
+        assert "compiles solve_lp: 2 cold (max 1.75s)" in txt
+        assert "1 hit" in txt and "code 4KiB" in txt
+        assert "perf solve_lp:" in txt
+        assert "chunk p50~" in txt and "compute/chunk p95~" in txt
+        assert "compile cold p95~" in txt
+
+    def test_pre_v4_journal_renders_without_footers(self, tmp_path):
+        recs = _base_journal()
+        recs[0]["schema_version"] = 3
+        recs.append(_close({
+            'serve_latency_seconds{priority="normal"}':
+            {"count": 1, "sum": 0.05, "buckets": {"0.1": 1, "+Inf": 0}},
+        }))
+        rc, txt = _render(tmp_path, recs)
+        assert rc == 0
+        assert "compiles " not in txt and "perf " not in txt
+        assert "serve latency" in txt  # older footers untouched
+
+    def test_torn_compile_event_degrades(self, tmp_path):
+        recs = _base_journal() + [
+            {"kind": "compile_event"},  # all fields torn away
+            {"kind": "compile_event", "entry": "solve_lp", "cache": "cold"},
+        ]
+        rc, txt = _render(tmp_path, recs)
+        assert rc == 0
+        assert "compiles" in txt  # counted, just without timings

@@ -15,8 +15,9 @@ Two sources:
   ``/healthz`` for liveness, ``/slo`` for burn rates) every
   ``--interval`` seconds; qps comes from counter deltas between polls.
   When the exporter has a `SeriesStore` attached, ``/query`` windows
-  become unicode sparklines (queue depth, per-shard in-flight) and
-  ``/alerts`` becomes a firing-alerts panel under the table.
+  become unicode sparklines (queue depth, per-shard in-flight, and live
+  per-entry MXU utilization when a `obs.perf.PerfProbe` is attached)
+  and ``/alerts`` becomes a firing-alerts panel under the table.
 - **offline**: ``--snapshot FILE`` renders one frame from a registry
   snapshot JSON (an exporter ``/snapshot`` capture, or the ``metrics``
   field of a journal's close record).
@@ -246,7 +247,10 @@ def spark_lines(queries: Dict[str, Optional[Dict[str, Any]]]) -> List[str]:
             if not vals:
                 continue
             _, labels = parse_series(s["series"])
-            tag = name + (f"[{labels['shard']}]" if "shard" in labels else "")
+            tag = name
+            for lk in ("shard", "entry"):  # entry: perf_mxu_utilization
+                if lk in labels:
+                    tag += f"[{labels[lk]}]"
             lines.append(
                 f"  {tag:<28} {spark(vals):<32} last {_fmt(vals[-1])}"
             )
@@ -370,9 +374,13 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
         slo = _get_json(url + "/slo")
         # /query + /alerts 404 on exporters without a store/manager
         # attached; _get_json turns that into None and the panels vanish
+        # perf_mxu_utilization is the PerfProbe's measured-roofline gauge
+        # (obs/perf.py): sampled into the store like any registry gauge,
+        # absent (and dropped below) when no probe is attached
         queries = {
             name: _get_json(url + f"/query?name={name}&window=300")
-            for name in ("serve_queue_depth", "serve_shard_inflight")
+            for name in ("serve_queue_depth", "serve_shard_inflight",
+                         "perf_mxu_utilization")
         }
         queries = {k: v for k, v in queries.items()
                    if v and not v.get("error")}
@@ -511,11 +519,22 @@ def self_check() -> int:
                 {"series": 'serve_shard_inflight{shard="1"}', "t": [], "v": []},
             ],
         },
+        "perf_mxu_utilization": {
+            "series": [
+                {"series": 'perf_mxu_utilization{entry="solve_lp_adaptive"}',
+                 "t": [1, 2], "v": [0.12, 0.31]},
+            ],
+        },
     }
     sl = spark_lines(q)
     check(
         "spark_lines labels shards, skips empty windows",
-        len(sl) == 2 and any("serve_shard_inflight[0]" in x for x in sl),
+        len(sl) == 3 and any("serve_shard_inflight[0]" in x for x in sl),
+        str(sl),
+    )
+    check(
+        "MXU utilization window labeled by entry",
+        any("perf_mxu_utilization[solve_lp_adaptive]" in x for x in sl),
         str(sl),
     )
     al = alert_lines({

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Top-K HLO ops by FLOPs/bytes for the solver entry points.
+
+    python tools/hlo_top.py                       # dense + banded tables
+    python tools/hlo_top.py --entry dense --top 15
+    python tools/hlo_top.py --entry pdhg --n 24 --m 12
+    python tools/hlo_top.py --self-check          # CI smoke
+
+Renders the per-op ledger of `obs.cost.hlo_ledger` — which dots,
+Cholesky factorizations, and triangular solves actually carry the FLOPs
+of one compiled entry point. This is the concrete kernel target list for
+ROADMAP item 5 (Pallas KKT kernels): the top table rows are the ops a
+custom kernel must beat, with their static FLOP share as the ceiling on
+what beating them can win (Amdahl). FLOP counts are shape-derived
+estimates with loop bodies counted once — relative weight, not absolute
+truth (see the obs.cost module docstring).
+
+Exit codes: 0 = tables rendered / self-check passed, 2 = failure.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fmt_count(v: float) -> str:
+    for unit, div in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def render_ledger(label: str, ledger: Dict[str, Any], out=sys.stdout) -> None:
+    print(f"== {label}: {ledger['instruction_count']} instructions, "
+          f"{_fmt_count(ledger['total_flops'])} flops, "
+          f"{_fmt_count(ledger['total_bytes'])}B touched", file=out)
+    if ledger.get("error"):
+        print(f"   ({ledger['error']})", file=out)
+        return
+    print(f"   {'opcode':<20} {'count':>6} {'flops':>10} {'share':>7} "
+          f"{'bytes':>10}", file=out)
+    for agg in ledger["by_op"][:12]:
+        print(f"   {agg['opcode']:<20} {agg['count']:>6} "
+              f"{_fmt_count(agg['flops']):>10} "
+              f"{agg['flops_share']:>6.1%} "
+              f"{_fmt_count(agg['bytes']):>10}", file=out)
+    print(f"   -- top instructions (kernel targets)", file=out)
+    for ins in ledger["top_instructions"]:
+        print(f"   {ins['opcode']:<20} {_fmt_count(ins['flops']):>10} "
+              f"{_fmt_count(ins['bytes']):>9}B  %{ins['name']}", file=out)
+
+
+# -- entry-point problem builders --------------------------------------
+# Small feasible instances: the ledger is about op structure, which the
+# problem SIZE scales but the problem VALUES never change.
+
+
+def _dense_lp(n: int = 12, m: int = 6, batch: Optional[int] = None):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dispatches_tpu.core.program import LPData
+
+    r = np.random.default_rng(0)
+    shape = (batch,) if batch else ()
+
+    def mk(seed):
+        rr = np.random.default_rng(seed)
+        A = rr.normal(size=(m, n))
+        return A, A @ rr.uniform(0.5, 1.0, n), rr.uniform(0.5, 1.5, n)
+
+    if batch:
+        As, bs, cs = zip(*(mk(s) for s in range(batch)))
+        A, b, c = np.stack(As), np.stack(bs), np.stack(cs)
+    else:
+        A, b, c = mk(0)
+    return LPData(
+        jnp.asarray(A), jnp.asarray(b), jnp.asarray(c),
+        jnp.zeros(shape + (n,)), jnp.full(shape + (n,), 10.0),
+        jnp.asarray(0.0),
+    )
+
+
+def dense_ledger(top_k: int, n: int, m: int) -> Dict[str, Any]:
+    """The dense-KKT IPM entry (`solve_lp`): normal-equations assembly,
+    Cholesky, and the two triangular solves per iteration."""
+    from dispatches_tpu.obs.cost import jit_ledger
+    from dispatches_tpu.solvers.ipm import solve_lp
+
+    return jit_ledger(solve_lp, _dense_lp(n, m), top_k=top_k)
+
+
+def banded_ledger(top_k: int, horizon: int = 24) -> Dict[str, Any]:
+    """The banded SPIKE IPM entry (`solve_lp_banded`) on the flagship
+    price-taker at a short horizon: the scan of block Cholesky solves."""
+    import jax
+    import jax.numpy as jnp
+
+    from dispatches_tpu.case_studies.renewables import params as P
+    from dispatches_tpu.case_studies.renewables.pricetaker import (
+        HybridDesign,
+        build_pricetaker,
+    )
+    from dispatches_tpu.obs.cost import jit_ledger
+    from dispatches_tpu.solvers.structured import (
+        extract_time_structure,
+        solve_lp_banded,
+    )
+
+    data = P.load_rts303()
+    design = HybridDesign(
+        T=horizon, with_battery=True, with_pem=True, design_opt=True,
+        h2_price_per_kg=2.5, initial_soc_fixed=None,
+    )
+    prog, _ = build_pricetaker(design)
+    meta = extract_time_structure(prog, horizon, block_hours=12)
+    blp = meta.instantiate({
+        "lmp": jnp.asarray(data["da_lmp"][:horizon]),
+        "wind_cf": jnp.asarray(data["da_wind_cf"][:horizon]),
+    })
+    jitted = jax.jit(lambda b: solve_lp_banded(meta, b, max_iter=20))
+    return jit_ledger(jitted, blp, top_k=top_k)
+
+
+def pdhg_ledger(top_k: int, n: int, m: int) -> Dict[str, Any]:
+    """The first-order PDHG entry (`solve_lp_pdhg`): segment-sum matvecs
+    instead of factorizations."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dispatches_tpu.core.program import SparseLP
+    from dispatches_tpu.obs.cost import jit_ledger
+    from dispatches_tpu.solvers.pdhg import solve_lp_pdhg
+
+    lp = _dense_lp(n, m)
+    A = np.asarray(lp.A)
+    rows, cols = np.nonzero(np.ones_like(A))
+    slp = SparseLP(
+        jnp.asarray(rows.astype(np.int32)),
+        jnp.asarray(cols.astype(np.int32)),
+        jnp.asarray(A[rows, cols]),
+        lp.b, lp.c, lp.l, lp.u, lp.c0,
+    )
+    return jit_ledger(
+        lambda d: solve_lp_pdhg(d, max_iter=2000), slp, top_k=top_k
+    )
+
+
+_ENTRIES = {
+    "dense": lambda a: dense_ledger(a.top, a.n, a.m),
+    "banded": lambda a: banded_ledger(a.top, a.horizon),
+    "pdhg": lambda a: pdhg_ledger(a.top, a.n, a.m),
+}
+
+
+# -- self-check --------------------------------------------------------
+
+# hand-written optimized-HLO fixture covering the parser's load-bearing
+# cases: inline-shaped and bare-name operands, tuple types, a dot with
+# contracting dims, a movement op, and a transcendental
+_FIXTURE_HLO = """\
+HloModule jit_fixture, entry_computation_layout={(f32[8,16]{1,0})->f32[8,8]{1,0}}
+
+%helper (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  ROOT %exp.1 = f32[8,8]{1,0} exponential(%p0)
+}
+
+ENTRY %main.9 (Arg_0.1: f32[8,16]) -> f32[8,8] {
+  %Arg_0.1 = f32[8,16]{1,0} parameter(0)
+  %transpose.2 = f32[16,8]{0,1} transpose(%Arg_0.1), dimensions={1,0}
+  %dot.3 = f32[8,8]{1,0} dot(f32[8,16]{1,0} %Arg_0.1, f32[16,8]{0,1} %transpose.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %tuple.4 = (f32[8,8]{1,0}, f32[8,16]{1,0}) tuple(%dot.3, %Arg_0.1)
+  %gte.5 = f32[8,8]{1,0} get-tuple-element(%tuple.4), index=0
+  %cholesky.6 = f32[8,8]{1,0} cholesky(%gte.5), lower=true
+  %solve.7 = f32[8,8]{1,0} triangular-solve(%cholesky.6, %gte.5), lower=true
+  ROOT %add.8 = f32[8,8]{1,0} add(%solve.7, %cholesky.6)
+}
+"""
+
+
+def self_check(out=sys.stdout) -> int:
+    from dispatches_tpu.obs.cost import hlo_ledger, parse_hlo_module
+
+    checks: List = []
+
+    def ck(name: str, ok: bool) -> None:
+        checks.append((name, ok))
+
+    instrs = {i["name"]: i for i in parse_hlo_module(_FIXTURE_HLO)}
+    ck("fixture parses every instruction", len(instrs) == 10)
+    ck("dot flops = 2*K*out (K=16 from lhs_contracting_dims)",
+       instrs.get("dot.3", {}).get("flops") == 2.0 * 16 * 64)
+    ck("cholesky flops = n^3/3",
+       instrs.get("cholesky.6", {}).get("flops") == 8 ** 3 / 3.0)
+    ck("triangular-solve flops = n*out_elems",
+       instrs.get("solve.7", {}).get("flops") == 8.0 * 64)
+    ck("movement ops cost zero flops",
+       instrs.get("transpose.2", {}).get("flops") == 0.0
+       and instrs.get("tuple.4", {}).get("flops") == 0.0)
+    ck("transcendental counted in nested computation",
+       instrs.get("exp.1", {}).get("transcendentals") == 64.0)
+    ck("tuple type bytes sum components",
+       instrs.get("tuple.4", {}).get("out_bytes") == 4 * (64 + 128))
+    ck("bare-name operand resolves via module map",
+       instrs.get("cholesky.6", {}).get("operand_bytes") == 4 * 64)
+
+    led = hlo_ledger(_FIXTURE_HLO, top_k=3)
+    ck("ledger ranks dot first by flops",
+       bool(led["by_op"]) and led["by_op"][0]["opcode"] == "dot")
+    ck("ledger top-K honours K", len(led["top_instructions"]) == 3)
+    ck("flops_share sums to ~1",
+       abs(sum(a["flops_share"] for a in led["by_op"]) - 1.0) < 1e-9)
+
+    # live: the two entry points ROADMAP item 5 targets must both emit a
+    # non-trivial table with a factorization-bearing op in it
+    for label, fn in (
+        ("dense", lambda: dense_ledger(8, 12, 6)),
+        ("banded", lambda: banded_ledger(8)),
+    ):
+        try:
+            live = fn()
+            ops = {a["opcode"] for a in live["by_op"]}
+            ck(f"live {label} ledger non-empty",
+               live["instruction_count"] > 0 and live["total_flops"] > 0)
+            ck(f"live {label} ledger sees compute ops",
+               bool(ops & {"dot", "cholesky", "triangular-solve",
+                           "fusion", "while"}))
+            render_ledger(f"live {label}", live, out)
+        except Exception as e:
+            ck(f"live {label} ledger", False)
+            print(f"   live {label} failed: {type(e).__name__}: {e}",
+                  file=out)
+
+    ok = True
+    for name, good in checks:
+        if not good:
+            ok = False
+        print(f"  [{'ok' if good else 'FAIL'}] {name}", file=out)
+    print(("self-check passed" if ok else "self-check FAILED")
+          + f" ({len(checks)} checks)", file=out)
+    return 0 if ok else 2
+
+
+def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hlo_top",
+        description="Top-K HLO ops by FLOPs/bytes per solver entry point.",
+    )
+    ap.add_argument("--entry", choices=sorted(_ENTRIES) + ["all"],
+                    default="all")
+    ap.add_argument("--top", type=int, default=10, help="top-K instructions")
+    ap.add_argument("--n", type=int, default=12, help="LP variables")
+    ap.add_argument("--m", type=int, default=6, help="LP constraints")
+    ap.add_argument("--horizon", type=int, default=24,
+                    help="banded-entry hours")
+    ap.add_argument("--self-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check(out)
+
+    names = sorted(_ENTRIES) if args.entry == "all" else [args.entry]
+    rc = 0
+    for name in names:
+        try:
+            render_ledger(name, _ENTRIES[name](args), out)
+        except Exception as e:
+            print(f"hlo_top: {name} failed: {type(e).__name__}: {e}",
+                  file=out)
+            rc = 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

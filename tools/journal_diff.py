@@ -105,6 +105,19 @@ _ZERO_SEEDED = (
     # ENDED degraded) gates even harder; resolved counters seed too but
     # gate only on a same-workload drop (recoveries stopped happening).
     "alerts_fired_total", "alerts_resolved_total", "alerts_firing",
+    # compile telemetry (obs/perf.py): a warm run recompiling is a real
+    # regression even when the baseline journal predates the counter, so
+    # misses seed zero and appear-from-zero gates. Hits seed too but, as
+    # higher-is-better, only gate on a same-workload DROP (warm path
+    # stopped being warm). The perf_* probe counters (perf_chunks_total,
+    # perf_model_flops_total, compile_seconds buckets) are deliberately
+    # NOT here: like shard_telemetry_frames they exist only when the
+    # opt-in probe is attached, so a probe-on run against a probe-off
+    # baseline must not trip the gate. (The `cache="hit"` LABEL on
+    # compile_seconds never matches the "cache_hit" direction substring —
+    # the closing quote intervenes — so those histograms stay
+    # lower-is-better, as a latency should.)
+    "compile_cache_miss_total", "compile_cache_hit_total",
 )
 
 
@@ -292,7 +305,12 @@ def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
                     if _is_num(v):
                         add(f"metric/{series}", float(v))
                 for series, h in (mets.get("histograms") or {}).items():
-                    if series.startswith("serve_"):
+                    # serve-tier latencies, compile_seconds (a compile
+                    # getting slower is a gateable latency), and the
+                    # perf probe's phase/chunk walls all diff as p95s
+                    if (series.startswith("serve_")
+                            or series.startswith("compile_seconds")
+                            or series.startswith("perf_")):
                         p = _hist_p95(h)
                         if p is not None:
                             out[f"metric/{series}/p95"] = p
@@ -840,6 +858,72 @@ def self_check(out=sys.stdout) -> int:
     checks.append((
         "resolutions alone appearing vs clean baseline pass "
         "(higher-is-better never gates on growth)",
+        False, any(r["regression"] for r in rows)))
+
+    # performance observatory (obs/perf.py): compile_seconds p95s gate as
+    # latencies (the cache="hit" label never matches the "cache_hit"
+    # direction substring — the quote intervenes), compile cache counters
+    # zero-seed, and the probe's own perf_* volume counters never gate a
+    # probe-on run against a probe-off baseline
+    pbase = {
+        'metric/compile_seconds{cache="cold",entry="solve_lp_adaptive"}/p95':
+        2.0,
+        'metric/compile_seconds{cache="hit",entry="solve_lp_adaptive"}/p95':
+        0.002,
+        'metric/compile_cache_hit_total{entry="solve_lp_adaptive"}': 30.0,
+        'metric/compile_cache_miss_total{entry="solve_lp_adaptive"}': 2.0,
+        'metric/perf_phase_seconds{entry="solve_lp_adaptive",phase="compute"}/p95':
+        0.08,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def prun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(pbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    prun("identical perf metrics pass", dict(pbase), False)
+    prun("cold compile p95 regression >10% fails (lower is better)",
+         {**pbase,
+          'metric/compile_seconds{cache="cold",entry="solve_lp_adaptive"}/p95':
+          3.0}, True)
+    prun('hit-path dispatch p95 regression fails (cache="hit" label is '
+         "still a latency, not a cache_hit counter)",
+         {**pbase,
+          'metric/compile_seconds{cache="hit",entry="solve_lp_adaptive"}/p95':
+          0.02}, True)
+    prun("compile hit count dropping >10% fails (warm path went cold)",
+         {**pbase,
+          'metric/compile_cache_hit_total{entry="solve_lp_adaptive"}': 10.0},
+         True)
+    prun("compile hit count growing passes (higher is better)",
+         {**pbase,
+          'metric/compile_cache_hit_total{entry="solve_lp_adaptive"}': 60.0},
+         False)
+    prun("miss count tripling fails (recompile storm)",
+         {**pbase,
+          'metric/compile_cache_miss_total{entry="solve_lp_adaptive"}': 6.0},
+         True)
+    prun("probe phase p95 regression >10% fails",
+         {**pbase,
+          'metric/perf_phase_seconds{entry="solve_lp_adaptive",phase="compute"}/p95':
+          0.2}, True)
+    cleanp = {"serve/loadgen/goodput_rps": 120.0}
+    rows = compare(cleanp, {
+        **cleanp,
+        'metric/compile_cache_miss_total{entry="solve_lp_adaptive"}': 4.0,
+    })
+    checks.append((
+        "misses appearing vs pre-telemetry baseline fail (zero-seeded)",
+        True, any(r["regression"] for r in rows)))
+    rows = compare(cleanp, {
+        **cleanp,
+        'metric/perf_chunks_total{entry="solve_lp_adaptive"}': 40.0,
+        'metric/perf_model_flops_total{entry="solve_lp_adaptive"}': 1e12,
+        'metric/compile_cache_hit_total{entry="solve_lp_adaptive"}': 30.0,
+    })
+    checks.append((
+        "probe-on run vs probe-off baseline passes "
+        "(perf_* volume counters are not zero-seeded)",
         False, any(r["regression"] for r in rows)))
 
     ok = True

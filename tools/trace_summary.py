@@ -22,6 +22,13 @@ manifest record). For each run this prints:
   service), per-request wait/compute/transfer columns on the serve solve
   lines and a journeys footer with terminal counts and per-priority
   phase p95s — pre-v3 journals render exactly as before;
+- when the run holds schema-v4 ``compile_event`` records (an `obs.perf`
+  PerfProbe was attached), a compiles footer with per-entry cold-compile
+  and hit-dispatch counts and times, plus per-entry measured-performance
+  columns (chunk wall and compute-seconds-per-chunk p50/p95, cold
+  compile p95) from the close snapshot's ``perf_*``/``compile_seconds``
+  histograms — pre-v4 journals and probe-off runs render exactly as
+  before;
 - cumulative retrace counts from the close record (or summed span deltas
   for a run that died before closing).
 
@@ -460,6 +467,96 @@ def _fmt_ms(v) -> str:
     return "—" if v is None else f"{v * 1e3:.1f}ms"
 
 
+def _series_labels(series: str):
+    """Split `name{k="v",...}` into (name, labels). Local and tolerant —
+    summarizing never imports obs.metrics (jax-adjacent); label values in
+    journals (entry/phase/cache names) never contain commas."""
+    name, _, rest = series.partition("{")
+    labels = {}
+    if rest.endswith("}"):
+        for part in rest[:-1].split(","):
+            k, eq, v = part.partition("=")
+            if eq:
+                labels[k.strip()] = v.strip().strip('"')
+    return name, labels
+
+
+def _print_compile_footer(run: List[dict], out) -> None:
+    """Per-entry compile telemetry from schema-v4 ``compile_event``
+    records: cold-compile count/worst time, hit-dispatch count (present
+    only when the probe journals hits), and generated-code size when the
+    probe captured executable costs. Silent for pre-v4 journals and
+    probe-off runs — no records, no footer."""
+    per: dict = {}
+    for ev in run:
+        if ev.get("kind") != "compile_event":
+            continue
+        d = per.setdefault(str(ev.get("entry") or "?"),
+                           {"cold": [], "hit": [], "code": 0})
+        cache = "hit" if ev.get("cache") == "hit" else "cold"
+        el = ev.get("elapsed_s")
+        d[cache].append(float(el) if isinstance(el, (int, float)) else None)
+        # size keys land flat on the record (capture_sizes cold compiles)
+        if isinstance(ev.get("generated_code_bytes"), (int, float)):
+            d["code"] += int(ev["generated_code_bytes"])
+    for entry in sorted(per):
+        d = per[entry]
+        bits = []
+        cold = [v for v in d["cold"] if v is not None]
+        if d["cold"]:
+            t = f" (max {max(cold):.2f}s)" if cold else ""
+            bits.append(f"{len(d['cold'])} cold{t}")
+        hit = [v for v in d["hit"] if v is not None]
+        if d["hit"]:
+            t = f" (max {max(hit) * 1e3:.1f}ms dispatch)" if hit else ""
+            bits.append(f"{len(d['hit'])} hit{t}")
+        if d["code"]:
+            bits.append(f"code {d['code'] / 2**10:.0f}KiB")
+        if bits:
+            print(f"  compiles {entry}: {', '.join(bits)}", file=out)
+
+
+def _print_perf(histograms: dict, out) -> None:
+    """Per-entry measured-performance columns from the close snapshot's
+    PerfProbe histograms: chunk wall p50/p95, compute-seconds-per-chunk
+    p95, and the cold-compile p95. Silent when the run had no probe (the
+    histogram snapshot simply has no perf_*/compile_seconds series)."""
+    chunks: dict = {}
+    compute: dict = {}
+    cold: dict = {}
+    for series, h in histograms.items():
+        name, labels = _series_labels(series)
+        entry = labels.get("entry", "?")
+        if name == "perf_chunk_seconds":
+            chunks[entry] = h
+        elif (name == "perf_phase_seconds"
+              and labels.get("phase") == "compute"):
+            compute[entry] = h
+        elif name == "compile_seconds" and labels.get("cache") == "cold":
+            cold[entry] = h
+    for entry in sorted(set(chunks) | set(compute) | set(cold)):
+        bits = []
+        h = chunks.get(entry)
+        if h:
+            bits.append(
+                f"chunk p50~{_fmt_ms(_snapshot_quantile(h, 0.5))}"
+                f" p95~{_fmt_ms(_snapshot_quantile(h, 0.95))}"
+                f" (n={h.get('count')})"
+            )
+        h = compute.get(entry)
+        if h:
+            bits.append(
+                f"compute/chunk p95~{_fmt_ms(_snapshot_quantile(h, 0.95))}"
+            )
+        h = cold.get(entry)
+        if h:
+            bits.append(
+                f"compile cold p95~{_fmt_ms(_snapshot_quantile(h, 0.95))}"
+            )
+        if bits:
+            print(f"  perf {entry}: {' '.join(bits)}", file=out)
+
+
 def _print_serve_latency(histograms: dict, out) -> None:
     """One line per serve_latency_seconds{...} series: count + p50/p95."""
     for series in sorted(histograms):
@@ -492,6 +589,7 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
     _print_health_footer(run, out)
     _print_warm_footer(run, out)
     _print_journeys_footer(run, out)
+    _print_compile_footer(run, out)
     close = next((e for e in run if e.get("kind") == "close"), None)
     if close is not None:
         totals = close.get("retrace_totals", {})
@@ -504,9 +602,9 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
                 f"{k}={v:g}" for k, v in sorted(counters.items())
             )
             print(f"  metrics: {txt}", file=out)
-        _print_serve_latency(
-            (close.get("metrics") or {}).get("histograms") or {}, out
-        )
+        hists = (close.get("metrics") or {}).get("histograms") or {}
+        _print_serve_latency(hists, out)
+        _print_perf(hists, out)
     else:
         # no close record — the run died; sum span deltas as best effort
         totals: dict = {}
