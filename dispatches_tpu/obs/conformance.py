@@ -1,0 +1,503 @@
+"""Numerical conformance plane (pillar 12): per-solve KKT certificates.
+
+Every health verdict in `obs.health` is derived from trajectory *shape* —
+a solve that converges cleanly to a slightly wrong optimum is invisible
+to it. The systems layered on top of the solvers (learned warm starts,
+the remediation ladder's f64/lane switches, compile-cache reuse, rolling
+deploys) are exactly the kind that fail by *silently degrading answers*,
+not by diverging. This module closes that gap with optimality
+certificates computed from the solution itself, in the original
+(unscaled) problem frame:
+
+- **primal feasibility**   ``‖b − Ax‖ / (1 + ‖b‖)``
+- **dual feasibility**     ``‖c − Aᵀy − zl + zu‖ / (1 + ‖c‖)`` (IPM) or
+  the projected-gradient form ``‖x − Π[l,u](x − (c − Aᵀy))‖ / (1 + ‖x‖)``
+  (PDHG, which carries no explicit bound duals)
+- **complementarity**      ``|Σ zl·(x−l) + Σ zu·(u−x)| / (1 + |c·x|)``
+- **relative duality gap** ``|pobj − dobj| / (1 + |pobj| + |dobj|)``
+
+The kernels are jit/vmap-safe (one jitted callable per problem family and
+batching layout, cached process-wide) and run on-device at harvest; only
+four scalars per lane cross to the host. Infinite bounds carry zero
+duals, and 0 is substituted for the bound BEFORE any product (``0 * inf``
+is NaN and would poison the sums even under a ``where`` mask — same
+discipline as `solvers.structured.optimal_value_banded`).
+
+`ConformanceChecker` wraps the kernels with a `ConformancePolicy`
+(per-certificate bounds), feeds the ``solve_residual_*`` histograms and
+the ``solve_conformance_total`` / ``solve_inaccurate_total`` counters,
+and renders the ``inaccurate`` health verdict (severity between
+``slow`` and ``cycling`` — the answer is wrong-ish, the process is
+fine). `default_conformance_rules` is the alert pack
+(``accuracy_burn``, ``canary_mismatch``) services install next to
+`alerts.default_fleet_rules` when the plane is on.
+
+Conformance is OFF by default everywhere (``conformance=None``); the
+checker only *reads* solutions — it never mutates rows, never enters a
+compile key, and never changes an executable — so ``conformance=True``
+is bitwise-neutral on solver results (tests/test_obs_conformance.py).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, fields as _dc_fields
+from typing import Any, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from . import metrics as obs_metrics
+from .alerts import AlertRule
+from .health import Verdict, severity
+
+# log-spaced ladder for relative-residual histograms: solver tolerances
+# live around 1e-8..1e-6, policy bounds around 1e-4, garbage at 1e-1+
+RESIDUAL_BUCKETS = (
+    1e-12, 1e-10, 1e-8, 1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0,
+)
+
+# certificate field order — the kernels return one (4,) vector in this
+# order so a lane's certificates cross the device boundary as one transfer
+FIELDS = ("res_primal", "res_dual", "comp", "gap")
+
+obs_metrics.describe(
+    "solve_residual_primal",
+    "Relative primal feasibility ‖b−Ax‖/(1+‖b‖) of harvested solutions, "
+    "by entry (solution frame, not the solver's scaled frame).",
+)
+obs_metrics.describe(
+    "solve_residual_dual",
+    "Relative dual feasibility of harvested solutions, by entry "
+    "(‖c−Aᵀy−zl+zu‖/(1+‖c‖) for IPM; projected-gradient form for PDHG).",
+)
+obs_metrics.describe(
+    "solve_residual_comp",
+    "Relative complementarity |Σ zl·(x−l)+Σ zu·(u−x)|/(1+|c·x|) of "
+    "harvested solutions, by entry.",
+)
+obs_metrics.describe(
+    "solve_residual_gap",
+    "Relative duality gap |pobj−dobj|/(1+|pobj|+|dobj|) of harvested "
+    "solutions, by entry.",
+)
+obs_metrics.describe(
+    "solve_conformance_total",
+    "Conformance checks by entry and outcome (pass / inaccurate / "
+    "nonfinite): every harvested solution the plane certified.",
+)
+obs_metrics.describe(
+    "solve_inaccurate_total",
+    "Solutions whose KKT certificates violated the conformance policy, "
+    "by entry — the accuracy-burn alert's numerator (zero-seeded by "
+    "services so the rate rule has a baseline).",
+)
+
+
+@dataclass(frozen=True)
+class ConformancePolicy:
+    """Per-certificate acceptance bounds (relative, solution frame).
+
+    The defaults sit ~2 decades above the solvers' convergence
+    tolerances — loose enough that a healthy f32 solve passes, tight
+    enough that a wrong answer (perturbed warm artifact, mis-mapped
+    lane switch) fails. ``max_verdict`` is where a violation lands in
+    the health taxonomy (``inaccurate``)."""
+
+    res_primal: float = 1e-4
+    res_dual: float = 1e-4
+    comp: float = 1e-4
+    gap: float = 1e-4
+
+    def bound(self, name: str) -> float:
+        return float(getattr(self, name))
+
+    def to_dict(self) -> Dict[str, float]:
+        return {f.name: float(getattr(self, f.name)) for f in _dc_fields(self)}
+
+
+def as_policy(policy) -> ConformancePolicy:
+    if policy is None:
+        return ConformancePolicy()
+    if isinstance(policy, ConformancePolicy):
+        return policy
+    if isinstance(policy, Mapping):
+        return ConformancePolicy(**{k: float(v) for k, v in policy.items()})
+    raise TypeError(f"cannot build a ConformancePolicy from {policy!r}")
+
+
+# ---------------------------------------------------------------------------
+# kernels: one (4,)-vector certificate per lane, original problem frame.
+# Pure jnp; jitted (and vmapped for batch layouts) lazily and cached by
+# (family, axes) so serving pays one compile per engine shape.
+
+_KERNELS: dict = {}
+_KERNEL_LOCK = threading.Lock()
+
+
+def _nrm(v):
+    import jax.numpy as jnp
+
+    return jnp.sqrt(jnp.sum(v * v))
+
+
+def _box_terms(l, u, x, zl, zu, c_dot_x):
+    """(comp, dual bound contribution) with infinite bounds masked to 0
+    before any product (0 * inf = NaN even under a where mask)."""
+    import jax.numpy as jnp
+
+    fin_l, fin_u = jnp.isfinite(l), jnp.isfinite(u)
+    l_s = jnp.where(fin_l, l, 0.0)
+    u_s = jnp.where(fin_u, u, 0.0)
+    comp_sum = jnp.sum(jnp.where(fin_l, zl * (x - l_s), 0.0)) + jnp.sum(
+        jnp.where(fin_u, zu * (u_s - x), 0.0)
+    )
+    comp = jnp.abs(comp_sum) / (1.0 + jnp.abs(c_dot_x))
+    dual_bound = jnp.sum(jnp.where(fin_l, zl * l_s, 0.0)) - jnp.sum(
+        jnp.where(fin_u, zu * u_s, 0.0)
+    )
+    return comp, dual_bound
+
+
+def _gap_rel(pobj, dobj):
+    import jax.numpy as jnp
+
+    return jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+
+
+def _dense_core(A, b, c, l, u, c0, x, y, zl, zu):
+    import jax.numpy as jnp
+
+    rp = _nrm(b - A @ x) / (1.0 + _nrm(b))
+    rd = _nrm(c - A.T @ y - zl + zu) / (1.0 + _nrm(c))
+    cx = c @ x
+    comp, dual_bound = _box_terms(l, u, x, zl, zu, cx)
+    pobj = cx + c0
+    dobj = b @ y + dual_bound + c0
+    return jnp.stack([rp, rd, comp, _gap_rel(pobj, dobj)])
+
+
+def _banded_core(col_pos, Ad, As, Bb, b, c, cb, lt, ut, lb, ub, c0,
+                 x, y, zl, zu):
+    # the scatter/einsum template of solvers.structured.optimal_value_banded:
+    # reduced solution vectors live in CompiledLP column order; col_pos
+    # places them into the flat [time-blocks | border] layout, where
+    # padding rows/columns carry all-zero A entries and zero c/b
+    import jax.numpy as jnp
+
+    Tb, mB, nB = Ad.shape
+    p = Bb.shape[-1]
+    nt = Tb * nB
+    dt = Ad.dtype
+
+    def scatter(v_red):
+        return jnp.zeros(nt + p, dt).at[col_pos].set(v_red.astype(dt))
+
+    def shift_down(a):
+        return jnp.concatenate([jnp.zeros_like(a[:1]), a[:-1]], axis=0)
+
+    def shift_up(a):
+        return jnp.concatenate([a[1:], jnp.zeros_like(a[:1])], axis=0)
+
+    x_flat = scatter(x)
+    zl_flat = scatter(zl)
+    zu_flat = scatter(zu)
+    yt = y.reshape(Tb, mB).astype(dt)
+    xt = x_flat[:nt].reshape(Tb, nB)
+    xb = x_flat[nt:]
+    Ax = (
+        jnp.einsum("tij,tj->ti", Ad, xt)
+        + jnp.einsum("tij,tj->ti", As, shift_down(xt))
+        + Bb @ xb
+    )
+    rp = _nrm((b - Ax).reshape(-1)) / (1.0 + _nrm(b.reshape(-1)))
+    ATy_t = jnp.einsum("tij,ti->tj", Ad, yt) + shift_up(
+        jnp.einsum("tij,ti->tj", As, yt)
+    )
+    ATy = jnp.concatenate([ATy_t.reshape(-1), jnp.einsum("tip,ti->p", Bb, yt)])
+    c_all = jnp.concatenate([c.reshape(-1), cb])
+    rd = _nrm(c_all - ATy - zl_flat + zu_flat) / (1.0 + _nrm(c_all))
+    l_all = jnp.concatenate([lt.reshape(-1), lb])
+    u_all = jnp.concatenate([ut.reshape(-1), ub])
+    cx = c_all @ x_flat
+    comp, dual_bound = _box_terms(l_all, u_all, x_flat, zl_flat, zu_flat, cx)
+    pobj = cx + c0
+    dobj = jnp.sum(yt * b) + dual_bound + c0
+    return jnp.stack([rp, rd, comp, _gap_rel(pobj, dobj)])
+
+
+def _pdhg_core(rows, cols, vals, b, c, l, u, c0, x, y):
+    # mirrors solvers.pdhg's own convergence test, but in the solution
+    # frame: projected-gradient dual residual (no explicit bound duals)
+    # and the bound-aware dual objective from the reduced costs' sign
+    import jax.numpy as jnp
+
+    M, N = b.shape[0], c.shape[0]
+    ax = jnp.zeros((M,), x.dtype).at[rows].add(vals * x[cols])
+    rp = _nrm(ax - b) / (1.0 + _nrm(b))
+    z = c - jnp.zeros((N,), y.dtype).at[cols].add(vals * y[rows])
+    rd = _nrm(x - jnp.clip(x - z, l, u)) / (1.0 + _nrm(x))
+    zl = jnp.maximum(z, 0.0)
+    zu = jnp.maximum(-z, 0.0)
+    cx = c @ x
+    comp, dual_bound = _box_terms(l, u, x, zl, zu, cx)
+    pobj = cx + c0
+    dobj = b @ y + dual_bound + c0
+    return jnp.stack([rp, rd, comp, _gap_rel(pobj, dobj)])
+
+
+def _get_kernel(family: str, axes):
+    """Jitted (family, batch-layout) kernel; `axes` is None for a single
+    lane or the problem NamedTuple's in-axes tuple for a vmapped batch
+    (solution leaves always batch along axis 0)."""
+    key = (family, tuple(axes) if axes is not None else None)
+    fn = _KERNELS.get(key)
+    if fn is not None:
+        return fn
+    with _KERNEL_LOCK:
+        fn = _KERNELS.get(key)
+        if fn is not None:
+            return fn
+        import jax
+
+        if family == "dense":
+            core, n_sol = _dense_core, 4
+        elif family == "banded":
+            core, n_sol = _banded_core, 4
+        elif family == "pdhg":
+            core, n_sol = _pdhg_core, 2
+        else:
+            raise ValueError(f"unknown conformance family {family!r}")
+        if axes is None:
+            fn = jax.jit(core)
+        else:
+            in_axes = tuple(axes) + (0,) * n_sol
+            if family == "banded":
+                in_axes = (None,) + in_axes
+            fn = jax.jit(jax.vmap(core, in_axes=in_axes))
+        _KERNELS[key] = fn
+        return fn
+
+
+def _family_of(problem) -> str:
+    name = type(problem).__name__
+    if name == "LPData":
+        return "dense"
+    if name == "BandedLP":
+        return "banded"
+    if name == "SparseLP":
+        return "pdhg"
+    raise TypeError(f"no conformance kernel for problem type {name}")
+
+
+def _sol_parts(family: str, row):
+    if family == "pdhg":
+        return (row.x, row.y)
+    return (row.x, row.y, row.zl, row.zu)
+
+
+def kkt_certificates(problem, sol, *, axes=None, meta=None) -> np.ndarray:
+    """Certificate vector(s) for `sol` against `problem`: shape ``(4,)``
+    for a single lane (``axes=None``) or ``(B, 4)`` for a batch whose
+    problem leaves batch along `axes` (None entries broadcast). Order is
+    `FIELDS`. Banded problems need `meta` (the `TimeStructure`) for the
+    reduced-column scatter."""
+    import jax.numpy as jnp
+
+    family = _family_of(problem)
+    fn = _get_kernel(family, axes)
+    args = tuple(jnp.asarray(a) for a in problem)
+    if family == "banded":
+        if meta is None:
+            raise ValueError("banded conformance checks need meta=")
+        args = (jnp.asarray(meta.col_pos),) + args
+    parts = tuple(jnp.asarray(p) for p in _sol_parts(family, sol))
+    return np.asarray(fn(*args, *parts))
+
+
+# ---------------------------------------------------------------------------
+# checker: policy + metrics + verdicts + aggregate report
+
+
+def _finite_fields(cert) -> Dict[str, float]:
+    return {name: float(v) for name, v in zip(FIELDS, np.asarray(cert))}
+
+
+class ConformanceChecker:
+    """Policy-carrying wrapper around the certificate kernels — the
+    object the ``conformance=`` hooks accept. Host-side state is just
+    outcome counts and per-entry worsts (lock-guarded; shard children
+    each carry their own checker). The checker never mutates solutions:
+    `check_row` / `check_batch` return plain dicts for journals and
+    stats, and feed the ``solve_residual_*`` histograms."""
+
+    def __init__(self, policy=None, *, meta=None):
+        self.policy = as_policy(policy)
+        self.meta = meta
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._worst: Dict[str, Dict[str, float]] = {}
+        self._checked = 0
+
+    # -- scoring -------------------------------------------------------
+    def score(self, fields: Mapping[str, float]) -> str:
+        vals = [fields.get(name) for name in FIELDS]
+        if any(v is None or not np.isfinite(v) for v in vals):
+            return "nonfinite"
+        for name in FIELDS:
+            if fields[name] > self.policy.bound(name):
+                return "inaccurate"
+        return "pass"
+
+    def verdict(self, fields: Mapping[str, Any]) -> Optional[Verdict]:
+        """An ``inaccurate`` (or ``nonfinite``) `health.Verdict` for a
+        failed check, None for a pass — blame lands on the worst
+        certificate relative to its bound."""
+        outcome = fields.get("outcome") or self.score(fields)
+        if outcome == "pass":
+            return None
+        if outcome == "nonfinite":
+            return Verdict("nonfinite", None, "res_primal",
+                           "non-finite conformance certificate")
+        worst = max(
+            FIELDS, key=lambda n: fields[n] / self.policy.bound(n)
+        )
+        return Verdict(
+            "inaccurate", None, worst,
+            f"{worst}={fields[worst]:.3e} exceeds policy bound "
+            f"{self.policy.bound(worst):.1e}",
+        )
+
+    # -- checks --------------------------------------------------------
+    def check_row(self, problem, row, *, entry: str,
+                  meta=None) -> Dict[str, Any]:
+        """Certify one harvested solution row. Returns the journal-ready
+        fields dict (certificates + outcome + ok)."""
+        cert = kkt_certificates(
+            problem, row, meta=meta if meta is not None else self.meta
+        )
+        fields = _finite_fields(cert)
+        return self.note(fields, entry=entry)
+
+    def check_batch(self, problem, axes, sol, *, entry: str,
+                    meta=None) -> Dict[str, Any]:
+        """Certify a stacked batch in one vmapped kernel call. Returns a
+        summary dict (`lanes` = per-lane fields dicts in lane order,
+        `ok` = every lane passed, `worst` = field-wise maxima) for
+        ``stats["conformance"]``."""
+        certs = kkt_certificates(
+            problem, sol, axes=axes,
+            meta=meta if meta is not None else self.meta,
+        )
+        lanes = [
+            self.note(_finite_fields(c), entry=entry) for c in certs
+        ]
+        worst = {
+            name: max(ln[name] for ln in lanes) for name in FIELDS
+        }
+        return {
+            "entry": entry,
+            "lanes": lanes,
+            "ok": all(ln["ok"] for ln in lanes),
+            "worst": worst,
+        }
+
+    def note(self, fields: Mapping[str, float], *,
+             entry: str) -> Dict[str, Any]:
+        """Record precomputed certificates (the fleet parent calls this
+        with numbers shipped from a shard child): observe histograms,
+        bump outcome counters, fold into the aggregate report. Returns
+        the enriched fields dict."""
+        outcome = self.score(fields)
+        out = {name: float(fields[name]) for name in FIELDS
+               if fields.get(name) is not None}
+        out["outcome"] = outcome
+        out["ok"] = outcome == "pass"
+        for name in FIELDS:
+            v = out.get(name)
+            if v is not None and np.isfinite(v):
+                obs_metrics.observe(
+                    f"solve_residual_{name.replace('res_', '')}",
+                    v, buckets=RESIDUAL_BUCKETS, entry=entry,
+                )
+        obs_metrics.inc(
+            "solve_conformance_total", entry=entry, outcome=outcome
+        )
+        if outcome != "pass":
+            obs_metrics.inc("solve_inaccurate_total", entry=entry)
+        with self._lock:
+            self._checked += 1
+            self._counts[outcome] = self._counts.get(outcome, 0) + 1
+            w = self._worst.setdefault(entry, {})
+            for name in FIELDS:
+                v = out.get(name)
+                if v is not None and np.isfinite(v):
+                    w[name] = max(w.get(name, 0.0), v)
+        return out
+
+    def seed_metrics(self, entry: str) -> None:
+        """Zero-seed the plane's counters so rate-kind alert rules have
+        a baseline before the first check lands."""
+        obs_metrics.inc("solve_inaccurate_total", 0, entry=entry)
+        obs_metrics.inc(
+            "solve_conformance_total", 0, entry=entry, outcome="pass"
+        )
+
+    # -- reporting -----------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "policy": self.policy.to_dict(),
+                "checked": self._checked,
+                "outcomes": dict(self._counts),
+                "worst": {e: dict(w) for e, w in self._worst.items()},
+            }
+
+
+def as_conformance(arg, *, meta=None) -> Optional[ConformanceChecker]:
+    """Coerce a ``conformance=`` argument: True → default checker,
+    a `ConformancePolicy`/mapping → checker with that policy, an
+    existing checker passes through (gaining `meta` if it has none),
+    None/False → None (the plane stays off)."""
+    if arg is None or arg is False:
+        return None
+    if isinstance(arg, ConformanceChecker):
+        if meta is not None and arg.meta is None:
+            arg.meta = meta
+        return arg
+    if arg is True:
+        return ConformanceChecker(meta=meta)
+    return ConformanceChecker(as_policy(arg), meta=meta)
+
+
+def escalate_verdict(verdict: str, conf: Optional[Mapping[str, Any]]) -> str:
+    """The serve layers' verdict override: a failed conformance check
+    upgrades a trajectory-healthy verdict to ``inaccurate``; anything
+    already at least as severe keeps its (more specific) name."""
+    if not conf or conf.get("ok", True):
+        return verdict
+    if severity(verdict) < severity("inaccurate"):
+        return "inaccurate"
+    return verdict
+
+
+def default_conformance_rules(*, window: float = 60.0) -> List[AlertRule]:
+    """The alert pack services add to `alerts.default_fleet_rules` when
+    the conformance plane (or a canary scheduler) is active. Both
+    counters are zero-seeded at service build so the rate rules see a
+    flat baseline, not an absent series."""
+    return [
+        AlertRule(
+            name="accuracy_burn", series="solve_inaccurate_total",
+            kind="rate", op=">", bound=0.0, window=window, for_=0.0,
+            severity="page",
+            description="harvested solutions are failing their KKT "
+            "conformance policy (silent wrong answers reaching callers)",
+        ),
+        AlertRule(
+            name="canary_mismatch", series="canary_mismatch_total",
+            kind="rate", op=">", bound=0.0, window=window, for_=0.0,
+            severity="page",
+            description="a golden canary solve came back outside "
+            "tolerance of its certified reference (bad warm artifact, "
+            "mis-mapped lane switch, or stale compile cache)",
+        ),
+    ]

@@ -23,6 +23,11 @@ three endpoints an operator actually points things at:
   deployments cost nothing.
 - ``/alerts``   — the attached `obs.alerts.AlertManager.report()`:
   firing instances, recent firing→resolved transitions, the rule pack.
+- ``/conformance`` — the attached ``conformance_fn`` (the fleet's
+  `FleetService.conformance_report`): the KKT checker's policy, outcome
+  counts, and worst certificates plus the canary scheduler's per-golden
+  last scores. 404 until a callback is attached, so deployments without
+  the accuracy plane cost nothing.
 
 Design rules, same as the rest of `obs`: stdlib only, off by default
 (nothing starts a server unless a tool passes ``--exporter-port``),
@@ -62,6 +67,7 @@ class TelemetryExporter:
         slos: Optional[Sequence[Any]] = None,
         store: Optional[Any] = None,
         alerts: Optional[Any] = None,
+        conformance_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.host = str(host)
         self.port = int(port)
@@ -71,6 +77,7 @@ class TelemetryExporter:
         self.slos = slos
         self.store = store  # obs.timeseries.SeriesStore, serves /query
         self.alerts = alerts  # obs.alerts.AlertManager, serves /alerts
+        self.conformance_fn = conformance_fn  # serves /conformance
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -149,6 +156,13 @@ class TelemetryExporter:
                 if self.alerts is None:
                     return 404, "text/plain; charset=utf-8", b"no alert manager attached\n"
                 return 200, "application/json", _json_bytes(self.alerts.report())
+            if path == "/conformance":
+                if self.conformance_fn is None:
+                    return (
+                        404, "text/plain; charset=utf-8",
+                        b"no conformance plane attached\n",
+                    )
+                return 200, "application/json", _json_bytes(self.conformance_fn())
             return 404, "text/plain; charset=utf-8", b"not found\n"
         except Exception as e:  # a broken callback must not kill the server
             return (

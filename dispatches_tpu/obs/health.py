@@ -28,10 +28,13 @@ Verdict taxonomy (docs/observability.md §7):
 - ``nonfinite`` — a NaN/Inf appears *inside* the recorded region (NaN padding
                   after the last recorded entry is normal and not flagged).
 
-Two extra verdicts appear in journals/metrics but are never produced by trace
-analysis: ``hang`` (emitted by `obs.watchdog` when a device call exceeds its
-timeout) and ``failed`` (emitted by `runtime.telemetry.SolveTelemetry` when
-the solve raised).
+Three extra verdicts appear in journals/metrics but are never produced by
+trace analysis: ``inaccurate`` (emitted by the `obs.conformance` plane when a
+harvested solution's KKT certificates violate the accuracy policy — the
+trajectory looked fine, the answer is wrong; docs/observability.md §12),
+``hang`` (emitted by `obs.watchdog` when a device call exceeds its timeout)
+and ``failed`` (emitted by `runtime.telemetry.SolveTelemetry` when the solve
+raised).
 
 Everything here is host-side numpy over trace pytrees already produced —
 solver outputs stay bitwise identical with the engine on (asserted in
@@ -65,8 +68,11 @@ CYCLE_AMP = 0.10  # cycling: minimum relative amplitude (flat != cycling)
 # shards, serve/fleet.py) and `unrecoverable` is the remediation
 # ladder's give-up verdict (runtime/remedy.py): both mean the system
 # *decided* to stop trying, which outranks any single bad trajectory.
+# `inaccurate` is the conformance plane's verdict (obs/conformance.py):
+# the answer came back wrong-ish while the trajectory looked fine —
+# worse than a slow-but-correct solve, better than a process pathology.
 SEVERITY = (
-    "healthy", "slow", "cycling", "stalled",
+    "healthy", "slow", "inaccurate", "cycling", "stalled",
     "deadline_exceeded", "shed", "shed_tenant_quota", "poisoned",
     "diverged", "nonfinite", "unrecoverable", "hang", "failed",
 )

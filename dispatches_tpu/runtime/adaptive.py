@@ -381,6 +381,40 @@ def _apply_remedy(
     return out, tr
 
 
+def _check_conformance(
+    conformance, fields_cls, data, axes, batch, out, entry,
+    *, meta=None, stats=None,
+):
+    """Post-drive conformance hook shared by the adaptive entry points:
+    certify every lane of the (possibly remediated) stacked result with
+    the KKT residual kernels (`obs.conformance`). Purely observational —
+    the solution arrays are returned to the caller untouched, so
+    ``conformance=`` anything is bitwise-neutral on solver results. The
+    summary lands in ``stats["conformance"]`` (one ``lanes`` entry per
+    lane, plus field-wise worsts) for journal attachment."""
+    from ..obs.conformance import FIELDS, as_conformance
+
+    checker = as_conformance(conformance, meta=meta)
+    if checker is None:
+        return None
+    problem = fields_cls(*data)
+    if batch is None:
+        fields = checker.check_row(problem, out, entry=entry, meta=meta)
+        summary = {
+            "entry": entry,
+            "lanes": [fields],
+            "ok": fields["ok"],
+            "worst": {name: fields[name] for name in FIELDS},
+        }
+    else:
+        summary = checker.check_batch(
+            problem, axes, out, entry=entry, meta=meta
+        )
+    if stats is not None:
+        stats["conformance"] = summary
+    return summary
+
+
 def _remedy_info(verdict, outcome) -> dict:
     """JSON-safe per-lane remediation record for stats/journals."""
     return {
@@ -615,6 +649,11 @@ class SlotEngine:
         # attributed chunk timings + compile telemetry. Host clocks only;
         # None keeps the hot path branch-free.
         self.perf = None
+        # optional conformance checker (obs.conformance): every harvested
+        # row is certified against its KKT conditions and the result rides
+        # in lane_stats["conformance"]. Observation-only — rows are never
+        # touched — so None vs a checker is bitwise-identical harvests.
+        self.conformance = None
 
     # -- slot management ----------------------------------------------
     def free_slots(self) -> int:
@@ -876,6 +915,7 @@ class SlotEngine:
             pc.mark("compute")
 
         out = []
+        slots = []
         retired = 0
         if finished.any():
             sol_np = self._sol_rows()
@@ -910,6 +950,7 @@ class SlotEngine:
                     if rinfo is not None:
                         lane_stats["remediation"] = rinfo
                 out.append((token, row, lane_stats))
+                slots.append(i)
                 self._release(i)
                 retired += 1
         if retired:
@@ -921,6 +962,17 @@ class SlotEngine:
                 watch.harvest_end([tok for tok, _, _ in out])
             if pc is not None:
                 pc.mark("harvest")
+            if self.conformance is not None:
+                # released slots' host mirrors persist until the next
+                # admit overwrites them, so the lane's problem is still
+                # reconstructible here; runs as its own perf phase for
+                # the bench overhead gate (<5% of compute)
+                for (_, row, lane_stats), i in zip(out, slots):
+                    lane_stats["conformance"] = self.conformance.check_row(
+                        self._row_problem(i), row, entry=self.entry
+                    )
+                if pc is not None:
+                    pc.mark("conformance")
         if pc is not None:
             pc.done(bucket=self.bucket, chunk=self.chunks, retired=retired)
         return out
@@ -933,6 +985,7 @@ def make_dense_engine(
     trace: bool = False,
     warm_predictor=None,
     remedy=None,
+    conformance=None,
     **solver_kw,
 ) -> "SlotEngine":
     """One dense-LP `SlotEngine` at `bucket` lanes — the construction
@@ -951,7 +1004,13 @@ def make_dense_engine(
     `remedy` (a `runtime.remedy.RemedyEngine` / `RemedyPolicy` / True)
     re-solves lanes that harvest unhealthy up the escalation ladder
     before they reach the caller; None (the default) leaves the harvest
-    untouched."""
+    untouched.
+
+    `conformance` (True / `ConformancePolicy` / `ConformanceChecker`)
+    certifies every harvested row against its KKT conditions
+    (`obs.conformance`) — observation-only, outside the compile key, so
+    the engine's executables and solution bits are identical either
+    way."""
     from ..core.program import LPData
 
     solver_kw.setdefault("max_iter", 60)
@@ -988,6 +1047,10 @@ def make_dense_engine(
         engine.remedy = as_remedy(
             remedy, solver_kw=solver_kw, entry="serve_dense"
         )
+    if conformance is not None:
+        from ..obs.conformance import as_conformance
+
+        engine.conformance = as_conformance(conformance)
     return engine
 
 
@@ -1052,6 +1115,7 @@ def solve_lp_adaptive(
     stats: Optional[dict] = None,
     remedy=None,
     perf=None,
+    conformance=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.ipm.solve_lp_batch`: identical
@@ -1078,7 +1142,14 @@ def solve_lp_adaptive(
     bitwise-identical to the historical path.
 
     `perf` (an `obs.perf.PerfProbe`) measures per-chunk phase timings and
-    compile latency; host-clock-only, so probe-on is bitwise probe-off."""
+    compile latency; host-clock-only, so probe-on is bitwise probe-off.
+
+    `conformance` (True / a `ConformancePolicy` / a `ConformanceChecker`)
+    certifies every returned lane against its KKT conditions after the
+    drive (and after any remediation), filling
+    ``stats["conformance"]`` and the ``solve_residual_*`` histograms.
+    Observational only: the returned arrays are bitwise-identical with
+    it on or off."""
     import jax
 
     from ..core.program import LPData
@@ -1096,12 +1167,17 @@ def solve_lp_adaptive(
         )
     if batch is None:
         out0 = solve_lp(lp, warm_start=warm_start, trace=trace, **solver_kw)
-        if remedy is None:
+        if remedy is None and conformance is None:
             return out0
         sol0, tr0 = out0 if trace else (out0, None)
-        sol0, tr0 = _apply_remedy(
-            remedy, LPData, lp, axes, None, sol0, tr0,
-            solver_kw.get("max_iter", 60), stats=stats,
+        if remedy is not None:
+            sol0, tr0 = _apply_remedy(
+                remedy, LPData, lp, axes, None, sol0, tr0,
+                solver_kw.get("max_iter", 60), stats=stats,
+            )
+        _check_conformance(
+            conformance, LPData, lp, axes, None, sol0, "solve_lp",
+            stats=stats,
         )
         return (sol0, tr0) if trace else sol0
     max_iter = solver_kw.get("max_iter", 60)
@@ -1135,6 +1211,9 @@ def solve_lp_adaptive(
         out, tr = _apply_remedy(
             remedy, LPData, lp, axes, batch, out, tr, max_iter, stats=stats
         )
+    _check_conformance(
+        conformance, LPData, lp, axes, batch, out, "solve_lp", stats=stats
+    )
     return (out, tr) if trace else out
 
 
@@ -1150,13 +1229,17 @@ def solve_lp_banded_adaptive(
     stats: Optional[dict] = None,
     remedy=None,
     perf=None,
+    conformance=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.structured.solve_lp_banded_batch`
     (same contract as `solve_lp_adaptive`, including `warm_predictor`
     seeding with cold-path fallback, the `remedy` escalation ladder on
-    unhealthy lanes, and the `perf` measurement probe; the year-scenario
-    path)."""
+    unhealthy lanes, the `perf` measurement probe, and the
+    observation-only `conformance` certificate check — which here routes
+    through the banded residual kernel, scattering the reduced solution
+    back to the flat frame exactly like `optimal_value_banded`; the
+    year-scenario path)."""
     import jax
 
     from ..solvers.ipm import IPMSolution
@@ -1181,12 +1264,17 @@ def solve_lp_banded_adaptive(
         out0 = solve_lp_banded(
             meta, blp, warm_start=warm_start, trace=trace, **solver_kw
         )
-        if remedy is None:
+        if remedy is None and conformance is None:
             return out0
         sol0, tr0 = out0 if trace else (out0, None)
-        sol0, tr0 = _apply_remedy(
-            remedy, BandedLP, blp, axes, None, sol0, tr0,
-            solver_kw.get("max_iter", 60), meta=meta, stats=stats,
+        if remedy is not None:
+            sol0, tr0 = _apply_remedy(
+                remedy, BandedLP, blp, axes, None, sol0, tr0,
+                solver_kw.get("max_iter", 60), meta=meta, stats=stats,
+            )
+        _check_conformance(
+            conformance, BandedLP, blp, axes, None, sol0,
+            "solve_lp_banded", meta=meta, stats=stats,
         )
         return (sol0, tr0) if trace else sol0
     max_iter = solver_kw.get("max_iter", 60)
@@ -1226,6 +1314,10 @@ def solve_lp_banded_adaptive(
             remedy, BandedLP, blp, axes, batch, out, tr, max_iter,
             meta=meta, stats=stats,
         )
+    _check_conformance(
+        conformance, BandedLP, blp, axes, batch, out, "solve_lp_banded",
+        meta=meta, stats=stats,
+    )
     return (out, tr) if trace else out
 
 
@@ -1240,6 +1332,7 @@ def solve_lp_pdhg_adaptive(
     stats: Optional[dict] = None,
     remedy=None,
     perf=None,
+    conformance=None,
     **solver_kw,
 ):
     """Adaptive-batch PDHG over a batch of `SparseLP`s sharing one
@@ -1273,12 +1366,17 @@ def solve_lp_pdhg_adaptive(
         out0 = solve_lp_pdhg(
             lps, warm_start=warm_start, trace=trace, **solver_kw
         )
-        if remedy is None:
+        if remedy is None and conformance is None:
             return out0
         sol0, tr0 = out0 if trace else (out0, None)
-        sol0, tr0 = _apply_remedy(
-            remedy, SparseLP, lps, axes, None, sol0, tr0,
-            solver_kw.get("max_iter", 100_000), stats=stats,
+        if remedy is not None:
+            sol0, tr0 = _apply_remedy(
+                remedy, SparseLP, lps, axes, None, sol0, tr0,
+                solver_kw.get("max_iter", 100_000), stats=stats,
+            )
+        _check_conformance(
+            conformance, SparseLP, lps, axes, None, sol0, "solve_lp_pdhg",
+            stats=stats,
         )
         return (sol0, tr0) if trace else sol0
     if axes[0] == 0 or axes[1] == 0:
@@ -1325,6 +1423,10 @@ def solve_lp_pdhg_adaptive(
             remedy, SparseLP, lps, axes, batch, out, tr, max_iter,
             stats=stats,
         )
+    _check_conformance(
+        conformance, SparseLP, lps, axes, batch, out, "solve_lp_pdhg",
+        stats=stats,
+    )
     return (out, tr) if trace else out
 
 

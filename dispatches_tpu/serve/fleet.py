@@ -154,6 +154,8 @@ class FleetService:
         store=None,
         alert_rules=None,
         slo_fn=None,
+        conformance=None,
+        canary=None,
     ):
         if not shards:
             raise ValueError("a fleet needs at least one shard")
@@ -188,6 +190,24 @@ class FleetService:
             remedy, solver_kw=ref.solver_kw, entry="serve_fleet",
             clock=clock,
         )
+        # numerical conformance plane (docs/observability.md §12): shard
+        # children compute KKT certificates at harvest and ship the four
+        # scalars in result frames; the parent re-observes them here so
+        # the residual histograms, the accuracy alert pack, and the
+        # retained tracks all live in ONE registry. The canary scheduler
+        # injects golden problems through the full submit->router->shard
+        # path from pump() (re-entrant under self._lock).
+        self.conformance = None
+        if conformance is not None and conformance is not False:
+            from ..obs.conformance import as_conformance
+
+            self.conformance = as_conformance(conformance)
+            self.conformance.seed_metrics(name)
+        self.canary = None
+        if canary is not None and canary is not False:
+            from .canary import as_canary
+
+            self.canary = as_canary(canary, clock=clock, service=self)
         # time-series retention + alerting plane (docs/observability.md
         # §10; off by default and bitwise-neutral for solve results):
         # pump() samples the store on the service clock and evaluates the
@@ -211,6 +231,12 @@ class FleetService:
                 if alert_rules is None
                 else list(alert_rules)
             )
+            if alert_rules is None and (
+                self.conformance is not None or self.canary is not None
+            ):
+                from ..obs.conformance import default_conformance_rules
+
+                rules = list(rules) + default_conformance_rules()
             self.alerts = AlertManager(
                 self.store, rules, clock=clock, slo_fn=slo_fn
             )
@@ -330,6 +356,12 @@ class FleetService:
             done += self._harvest()
             self._supervise()
             self._respawn_due()
+            if self.canary is not None:
+                # score last round's harvested probes, inject the next
+                # when due; submit() re-enters self._lock (RLock), and
+                # injecting before _dispatch puts fresh probes on a
+                # shard this same cycle
+                self.canary.tick(now)
             self._dispatch(self.clock())
             done += self._enforce_inflight_deadlines()
             obs_metrics.set_gauge("serve_queue_depth", len(self.queue))
@@ -378,6 +410,7 @@ class FleetService:
                         k: msg[k]
                         for k in ("warm_source", "warm_accepted") if k in msg
                     },
+                    conformance=msg.get("conformance"),
                 )
                 done += 1
         return done
@@ -742,7 +775,7 @@ class FleetService:
 
     def _resolve_solved(
         self, req, row, iterations, *, shard: int, child_slot, journey=None,
-        warm_attrs=None,
+        warm_attrs=None, conformance=None,
     ) -> None:
         self.completed += 1
         now = self.clock()
@@ -772,6 +805,23 @@ class FleetService:
             )
             if rinfo is not None:
                 verdict = rinfo["verdict"]
+        conf = None
+        if self.conformance is not None:
+            from ..obs.conformance import escalate_verdict
+
+            if rinfo is not None:
+                # the parent ladder re-solved this row, so the child's
+                # certificates describe a superseded solution — re-check
+                # the row callers actually receive
+                conf = self.conformance.check_row(
+                    req.problem, row, entry=self.name
+                )
+            elif conformance is not None:
+                # re-observe the child-computed certificates parent-side
+                # so the accuracy alert pack and retained residual
+                # tracks see them in this registry
+                conf = self.conformance.note(conformance, entry=self.name)
+            verdict = escalate_verdict(verdict, conf)
         result = SolveResult(
             solution=row,
             verdict=verdict,
@@ -780,16 +830,21 @@ class FleetService:
             request_id=req.request_id,
         )
         if self.cache is not None and verdict in ("healthy", "slow"):
-            # ladder-exhausted (`unrecoverable`) rows never enter the
-            # cache: a bad answer must not become a future cache hit
+            # ladder-exhausted (`unrecoverable`) and conformance-failed
+            # (`inaccurate`) rows never enter the cache: a bad answer
+            # must not become a future cache hit
             self.cache.put(req.fingerprint, result)
-        status = "unrecoverable" if verdict == "unrecoverable" else "ok"
+        status = (
+            verdict if verdict in ("unrecoverable", "inaccurate") else "ok"
+        )
         obs_metrics.inc("serve_requests_total", status=status)
         obs_metrics.observe(
             "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
             status=status,
         )
         extra = {"remediation": rinfo} if rinfo is not None else {}
+        if conf is not None:
+            extra["conformance"] = conf
         get_tracer().solve_event(
             self.name, row,
             request_id=req.request_id, seq=req.seq,
@@ -953,6 +1008,19 @@ class FleetService:
         ))
 
     # -- introspection -------------------------------------------------
+    def conformance_report(self) -> dict:
+        """The exporter's ``/conformance`` payload: the checker's
+        aggregate (policy, outcome counts, worst certificates per entry)
+        plus the canary scheduler's per-golden last scores. Empty when
+        the plane is off."""
+        out: dict = {}
+        with self._lock:
+            if self.conformance is not None:
+                out["conformance"] = self.conformance.report()
+            if self.canary is not None:
+                out["canary"] = self.canary.report()
+        return out
+
     def stats(self) -> dict:
         with self._lock:
             out = {
@@ -988,6 +1056,10 @@ class FleetService:
             }
             if self.cache is not None:
                 out["cache"] = self.cache.stats()
+            if self.conformance is not None:
+                out["conformance"] = self.conformance.report()
+            if self.canary is not None:
+                out["canary"] = self.canary.report()
             if self.store is not None:
                 out["timeseries"] = self.store.stats()
             if self.alerts is not None:
@@ -1017,6 +1089,8 @@ def make_dense_fleet(
     stderr_dir: Optional[str] = None,
     spawn: bool = True,
     warm_model: Optional[str] = None,
+    conformance=None,
+    canary=None,
     **fleet_kw,
 ) -> FleetService:
     """A `FleetService` of `n_shards` dense-LP shard processes, each
@@ -1037,7 +1111,17 @@ def make_dense_fleet(
     All off by default and bitwise-neutral for solve results. `warm_model` (an artifact path
     from tools/train_warmstart.py; default None = today's cold path)
     makes every child seed cold dispatches through the solver's
-    safeguarded learned warm-start plumbing."""
+    safeguarded learned warm-start plumbing. ``conformance`` (True / a
+    `ConformancePolicy` / a mapping of bounds) spawns children with
+    ``--conformance`` — each shard engine computes per-row KKT
+    certificates at harvest and ships them in result frames; the parent
+    re-observes them, escalates failed rows to the ``inaccurate``
+    verdict, and (under ``timeseries=True``) appends the
+    `obs.conformance.default_conformance_rules` accuracy pack.
+    ``canary`` (a goldens ``.npz`` path, a golden list, or a
+    `serve.canary.CanaryScheduler`) injects certified golden problems
+    through the full router->shard path from ``pump()`` on a cadence
+    (docs/observability.md §12, docs/serving.md)."""
     import os
 
     from ..parallel.mesh import shard_device_env
@@ -1058,6 +1142,7 @@ def make_dense_fleet(
             telemetry=telemetry,
             reqtrace=reqtrace,
             warm_model=warm_model,
+            conformance=conformance is not None and conformance is not False,
         )
         for i in range(n_shards)
     ]
@@ -1065,5 +1150,6 @@ def make_dense_fleet(
     return FleetService(
         shards, queue_limit=queue_limit, tenants=tenants, cache=cache,
         clock=clock, reqtrace=reqtrace, spawn=spawn,
-        timeseries=timeseries, **fleet_kw,
+        timeseries=timeseries, conformance=conformance, canary=canary,
+        **fleet_kw,
     )

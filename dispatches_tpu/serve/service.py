@@ -375,6 +375,26 @@ class DispatchService:
                 f"({rinfo.get('attempts', 0)} attempts, "
                 f"original: {rinfo.get('original')})",
             )
+        # the conformance plane's certificate check (engine.conformance,
+        # obs/conformance.py) rides in `stats` the same way: a failed
+        # check upgrades a trajectory-healthy verdict to `inaccurate` —
+        # the trajectory looked fine, the answer is wrong
+        conf = stats.get("conformance")
+        if conf is not None and not conf.get("ok", True):
+            from ..obs.conformance import escalate_verdict
+
+            new_verdict = escalate_verdict(verdict, conf)
+            if new_verdict != verdict:
+                verdict = new_verdict
+                health = _service_health(
+                    "inaccurate",
+                    "KKT certificates exceed the conformance policy "
+                    + ", ".join(
+                        f"{k}={conf[k]:.2e}"
+                        for k in ("res_primal", "res_dual", "comp", "gap")
+                        if isinstance(conf.get(k), float)
+                    ),
+                )
         result = SolveResult(
             solution=row,
             verdict=verdict,
@@ -382,10 +402,15 @@ class DispatchService:
             latency=latency,
             request_id=req.request_id,
         )
-        if self.cache is not None and verdict != "unrecoverable":
-            # a ladder-exhausted answer must not become a future cache hit
+        if self.cache is not None and verdict not in (
+            "unrecoverable", "inaccurate"
+        ):
+            # a ladder-exhausted or policy-failing answer must not become
+            # a future cache hit
             self.cache.put(req.fingerprint, result)
-        status = "unrecoverable" if verdict == "unrecoverable" else "ok"
+        status = (
+            verdict if verdict in ("unrecoverable", "inaccurate") else "ok"
+        )
         obs_metrics.inc("serve_requests_total", status=status)
         obs_metrics.observe(
             "serve_latency_seconds", latency, buckets=LATENCY_BUCKETS,
@@ -397,6 +422,8 @@ class DispatchService:
         }
         if rinfo is not None:
             warm_attrs["remediation"] = rinfo
+        if conf is not None:
+            warm_attrs["conformance"] = conf
         get_tracer().solve_event(
             self.name, row,
             request_id=req.request_id, seq=req.seq,
@@ -483,6 +510,16 @@ class DispatchService:
         ))
 
     # -- introspection -------------------------------------------------
+    def conformance_report(self) -> dict:
+        """The exporter's ``/conformance`` payload for the in-process
+        service: the engine checker's aggregate. Empty when the plane
+        is off."""
+        with self._lock:
+            conf = getattr(self.engine, "conformance", None)
+            if conf is None:
+                return {}
+            return {"conformance": conf.report()}
+
     def stats(self) -> dict:
         with self._lock:
             out = {
@@ -498,6 +535,9 @@ class DispatchService:
             }
             if self.cache is not None:
                 out["cache"] = self.cache.stats()
+            conf = getattr(self.engine, "conformance", None)
+            if conf is not None:
+                out["conformance"] = conf.report()
             if self.store is not None:
                 out["timeseries"] = self.store.stats()
             for status in ("ok", "cached"):
@@ -523,6 +563,7 @@ def make_dense_service(
     perf: bool = False,
     warm_model=None,
     remedy=None,
+    conformance=None,
     **solver_kw,
 ) -> DispatchService:
     """A `DispatchService` over dense `LPData` rows solved by the IPM:
@@ -550,7 +591,13 @@ def make_dense_service(
     an `obs.perf.PerfProbe` as ``engine.perf``: every chunk gets
     phase-attributed wall time, compile hit/cold telemetry, and — with
     `timeseries=True` too — a live ``perf_mxu_utilization`` window
-    (docs/observability.md §11)."""
+    (docs/observability.md §11).
+
+    `conformance` (True / `ConformancePolicy` / `ConformanceChecker`;
+    default None = unchecked, bitwise-identical) certifies every
+    harvested row's KKT conditions at harvest, journals the certificates
+    on solve events, and escalates policy failures to the `inaccurate`
+    verdict (docs/observability.md §12)."""
     from ..runtime.adaptive import make_dense_engine
 
     remedy_engine = None
@@ -564,8 +611,11 @@ def make_dense_service(
         )
     engine = make_dense_engine(
         bucket, chunk_iters=chunk_iters, trace=trace,
-        warm_predictor=warm_model, remedy=remedy_engine, **solver_kw
+        warm_predictor=warm_model, remedy=remedy_engine,
+        conformance=conformance, **solver_kw
     )
+    if engine.conformance is not None:
+        engine.conformance.seed_metrics("serve_dense")
     if perf:
         from ..obs.perf import PerfProbe
 

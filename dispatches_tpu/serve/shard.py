@@ -40,7 +40,7 @@ Frames child -> parent::
 
     {"op": "pong", "seq": n}
     {"op": "result", "lane": id, "slot": s, "iterations": k,
-     "row": <row>, "journey": <marks>?}
+     "row": <row>, "journey": <marks>?, "conformance": <certs>?}
     {"op": "telemetry", "shard": k, "seq": n,
      "metrics": <snapshot delta>, "journal": [<records>]}
 
@@ -55,7 +55,10 @@ aggregates. With ``--reqtrace 1`` each result frame also carries the
 lane's chunk-loop journey marks (seconds relative to the child's
 receipt of the solve op), which the parent maps into the request's
 `obs.reqtrace` journey so compute time is attributed to the shard that
-did the work.
+did the work. With ``--conformance 1`` the engine computes per-row KKT
+certificates at harvest (`obs.conformance`) and each result frame
+carries the four scalars + outcome, which the parent re-observes into
+its own registry and escalates on (docs/observability.md §12).
 
 The ``fault`` op is the fault-injection surface `tests/test_serve_fleet.py`
 and the loadgen chaos leg drive: ``exit`` dies immediately (os._exit),
@@ -285,6 +288,9 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--warm-model", default=None,
                     help="learned warm-start artifact (learn/) seeding "
                          "cold dispatches through the solver safeguard")
+    ap.add_argument("--conformance", type=int, default=0,
+                    help="compute per-row KKT certificates at harvest "
+                         "and ship them in result frames")
     args = ap.parse_args(argv)
 
     if os.environ.get(DIE_ON_START_ENV) == "1":
@@ -354,7 +360,8 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     solver_kw = json.loads(args.solver_kw)
     engine = make_dense_engine(
         args.bucket, chunk_iters=args.chunk_iters,
-        warm_predictor=args.warm_model, **solver_kw
+        warm_predictor=args.warm_model,
+        conformance=bool(args.conformance) or None, **solver_kw
     )
 
     journeys: Optional[_LaneJourneys] = None
@@ -492,6 +499,12 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 "row": encode_row(row),
                 **warm_attrs,
             }
+            conf = stats.get("conformance")
+            if conf is not None:
+                # four scalars + outcome, already plain floats/strs:
+                # the parent re-observes these into ITS registry so the
+                # accuracy alert pack sees them without telemetry on
+                frame["conformance"] = conf
             if journeys is not None:
                 j = journeys.pop(lane)
                 if j is not None:
@@ -527,6 +540,7 @@ class ShardProcess:
         telemetry: bool = False,
         reqtrace: bool = False,
         warm_model: Optional[str] = None,
+        conformance: bool = False,
     ):
         self.shard_id = int(shard_id)
         self.bucket = int(bucket)
@@ -538,6 +552,7 @@ class ShardProcess:
         self.stderr_path = stderr_path
         self.telemetry = bool(telemetry)
         self.reqtrace = bool(reqtrace)
+        self.conformance = bool(conformance)
         self.proc: Optional[subprocess.Popen] = None
         self.lanes: Dict[Any, Any] = {}  # lane id -> SolveRequest
         self.last_ping: Optional[float] = None
@@ -570,6 +585,7 @@ class ShardProcess:
             "--solver-kw", json.dumps(self.solver_kw),
             "--telemetry", "1" if self.telemetry else "0",
             "--reqtrace", "1" if self.reqtrace else "0",
+            "--conformance", "1" if self.conformance else "0",
         ]
         if self.warm_model:
             cmd += ["--warm-model", os.path.abspath(self.warm_model)]

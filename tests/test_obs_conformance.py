@@ -1,0 +1,571 @@
+"""Numerical conformance plane: KKT certificate kernels, the policy
+checker, verdict escalation, golden canary artifacts, and the
+bitwise-neutrality contract of ``conformance=`` at every hook — the three
+adaptive entry points, `make_dense_service`, and `make_dense_fleet`.
+The plane only *reads* solutions; turning it on must never change one.
+"""
+import json
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData, SparseLP
+from dispatches_tpu.obs import metrics as obs_metrics
+from dispatches_tpu.obs.conformance import (
+    FIELDS,
+    ConformanceChecker,
+    ConformancePolicy,
+    as_conformance,
+    as_policy,
+    default_conformance_rules,
+    escalate_verdict,
+    kkt_certificates,
+)
+from dispatches_tpu.obs.journal import Tracer, read_journal, use_tracer
+from dispatches_tpu.obs.metrics import reset_metrics
+from dispatches_tpu.runtime.adaptive import (
+    solve_lp_adaptive,
+    solve_lp_banded_adaptive,
+    solve_lp_pdhg_adaptive,
+)
+from dispatches_tpu.serve import make_dense_service
+from dispatches_tpu.serve.canary import (
+    CanaryArtifactMismatch,
+    CanaryScheduler,
+    certify_golden,
+    load_goldens,
+    save_goldens,
+)
+from dispatches_tpu.solvers.ipm import solve_lp, solve_lp_batch
+
+KW = dict(max_iter=60)
+
+
+def _lp(seed, n=8, m=4, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    return LPData(
+        jnp.asarray(A, dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _stack(lps):
+    return LPData(*(
+        jnp.stack([jnp.asarray(lp[i]) for lp in lps])
+        for i in range(len(lps[0]))
+    ))
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+def _assert_bitwise(ref, out):
+    for name, a, b in zip(ref._fields, ref, out):
+        assert _biteq(a, b), f"field {name} differs bitwise"
+
+
+def _counter(snap, name, **labels):
+    total = 0.0
+    for series, v in (snap.get("counters") or {}).items():
+        if not series.startswith(name + "{") and series != name:
+            continue
+        if all(f'{k}="{val}"' in series for k, val in labels.items()):
+            total += v
+    return total
+
+
+def _hist_count(snap, name, **labels):
+    total = 0
+    for series, h in (snap.get("histograms") or {}).items():
+        if not series.startswith(name + "{") and series != name:
+            continue
+        if all(f'{k}="{val}"' in series for k, val in labels.items()):
+            total += h.get("count", 0)
+    return total
+
+
+# ---------------------------------------------------------------------
+# certificate kernels
+# ---------------------------------------------------------------------
+class TestKernels:
+    def test_dense_converged_solve_certifies_clean(self):
+        lp = _lp(3)
+        sol = solve_lp(lp, tol=1e-9, max_iter=200)
+        assert bool(np.asarray(sol.converged))
+        cert = kkt_certificates(lp, sol)
+        assert cert.shape == (4,)
+        assert np.all(np.isfinite(cert))
+        assert np.all(cert < 1e-6), cert
+
+    def test_perturbed_solution_fails_primal(self):
+        lp = _lp(3)
+        sol = solve_lp(lp, tol=1e-9, max_iter=200)
+        bad = sol._replace(x=sol.x + 0.1)
+        cert = kkt_certificates(lp, bad)
+        fields = dict(zip(FIELDS, (float(v) for v in cert)))
+        assert fields["res_primal"] > 1e-3
+        assert ConformanceChecker().score(fields) == "inaccurate"
+
+    def test_batched_kernel_matches_per_lane(self):
+        lps = [_lp(s) for s in (10, 11, 12)]
+        batch = _stack(lps)
+        sol = solve_lp_batch(batch, tol=1e-9, max_iter=200)
+        certs = kkt_certificates(batch, sol, axes=(0,) * 6)
+        assert certs.shape == (3, 4)
+        for i, lp in enumerate(lps):
+            row = SimpleNamespace(
+                x=sol.x[i], y=sol.y[i], zl=sol.zl[i], zu=sol.zu[i]
+            )
+            single = kkt_certificates(lp, row)
+            np.testing.assert_allclose(certs[i], single, rtol=1e-9, atol=1e-12)
+
+    def test_infinite_bounds_stay_finite(self):
+        # min x s.t. x = 1, 0 <= x <= inf: optimum x=1, y=1, zl=zu=0.
+        # The masked bound terms must not produce 0*inf = NaN.
+        lp = LPData(
+            jnp.asarray([[1.0]]), jnp.asarray([1.0]), jnp.asarray([1.0]),
+            jnp.asarray([0.0]), jnp.asarray([jnp.inf]), jnp.asarray(0.0),
+        )
+        row = SimpleNamespace(
+            x=jnp.asarray([1.0]), y=jnp.asarray([1.0]),
+            zl=jnp.asarray([0.0]), zu=jnp.asarray([0.0]),
+        )
+        cert = kkt_certificates(lp, row)
+        assert np.all(np.isfinite(cert))
+        assert np.all(cert < 1e-12), cert
+
+    def test_pdhg_kernel_trivial_optimum(self):
+        lps = SparseLP(
+            rows=jnp.asarray([0], jnp.int32), cols=jnp.asarray([0], jnp.int32),
+            vals=jnp.asarray([1.0]), b=jnp.asarray([1.0]),
+            c=jnp.asarray([1.0]), l=jnp.asarray([0.0]),
+            u=jnp.asarray([2.0]), c0=jnp.asarray(0.0),
+        )
+        row = SimpleNamespace(x=jnp.asarray([1.0]), y=jnp.asarray([1.0]))
+        cert = kkt_certificates(lps, row)
+        assert np.all(np.isfinite(cert))
+        assert np.all(cert < 1e-12), cert
+
+    def test_unknown_family_raises(self):
+        with pytest.raises(TypeError, match="no conformance kernel"):
+            kkt_certificates(("not", "a", "problem"), None)
+
+
+# ---------------------------------------------------------------------
+# checker: policy, scoring, metrics, verdicts
+# ---------------------------------------------------------------------
+class TestChecker:
+    CLEAN = {"res_primal": 1e-9, "res_dual": 1e-9, "comp": 1e-9, "gap": 1e-9}
+
+    def test_score_outcomes(self):
+        ch = ConformanceChecker()
+        assert ch.score(self.CLEAN) == "pass"
+        assert ch.score(dict(self.CLEAN, gap=1.0)) == "inaccurate"
+        assert ch.score(dict(self.CLEAN, comp=float("nan"))) == "nonfinite"
+        assert ch.score(dict(self.CLEAN, res_dual=None)) == "nonfinite"
+
+    def test_verdict_blames_worst_relative_field(self):
+        ch = ConformanceChecker(ConformancePolicy(res_primal=1e-2, gap=1e-6))
+        assert ch.verdict(self.CLEAN) is None
+        v = ch.verdict(dict(self.CLEAN, res_primal=5e-2, gap=1e-3))
+        # gap is 1000x over its bound, res_primal only 5x — blame gap
+        assert v.verdict == "inaccurate"
+        assert v.quantity == "gap"
+        v2 = ch.verdict(dict(self.CLEAN, comp=float("inf")))
+        assert v2.verdict == "nonfinite"
+
+    def test_note_feeds_metrics_and_report(self):
+        reset_metrics()
+        ch = ConformanceChecker()
+        ch.seed_metrics("t")
+        out = ch.note(self.CLEAN, entry="t")
+        assert out["ok"] and out["outcome"] == "pass"
+        bad = ch.note(dict(self.CLEAN, gap=0.5), entry="t")
+        assert not bad["ok"] and bad["outcome"] == "inaccurate"
+        snap = obs_metrics.snapshot()
+        assert _counter(snap, "solve_conformance_total",
+                        entry="t", outcome="pass") == 1
+        assert _counter(snap, "solve_conformance_total",
+                        entry="t", outcome="inaccurate") == 1
+        assert _counter(snap, "solve_inaccurate_total", entry="t") == 1
+        assert _hist_count(snap, "solve_residual_gap", entry="t") == 2
+        rep = ch.report()
+        assert rep["checked"] == 2
+        assert rep["outcomes"] == {"pass": 1, "inaccurate": 1}
+        assert rep["worst"]["t"]["gap"] == 0.5
+        assert rep["policy"] == ConformancePolicy().to_dict()
+
+    def test_seed_metrics_zero_seeds(self):
+        reset_metrics()
+        ConformanceChecker().seed_metrics("s")
+        snap = obs_metrics.snapshot()
+        assert _counter(snap, "solve_inaccurate_total", entry="s") == 0
+        assert 'solve_inaccurate_total{entry="s"}' in snap["counters"]
+
+    def test_policy_coercion(self):
+        assert as_policy(None) == ConformancePolicy()
+        p = as_policy({"gap": 1e-2})
+        assert p.gap == 1e-2 and p.res_primal == ConformancePolicy().res_primal
+        assert as_policy(p) is p
+        with pytest.raises(TypeError):
+            as_policy(42)
+        assert as_conformance(None) is None
+        assert as_conformance(False) is None
+        ch = as_conformance(True)
+        assert isinstance(ch, ConformanceChecker)
+        assert as_conformance(ch) is ch
+        assert as_conformance({"gap": 1e-2}).policy.gap == 1e-2
+
+    def test_escalate_verdict(self):
+        bad = {"ok": False}
+        assert escalate_verdict("healthy", bad) == "inaccurate"
+        assert escalate_verdict("slow", bad) == "inaccurate"
+        # already at least as severe: keep the more specific name
+        assert escalate_verdict("stalled", bad) == "stalled"
+        assert escalate_verdict("diverged", bad) == "diverged"
+        # a pass (or no check at all) never touches the verdict
+        assert escalate_verdict("healthy", {"ok": True}) == "healthy"
+        assert escalate_verdict("healthy", None) == "healthy"
+
+    def test_default_rules(self):
+        rules = {r.name: r for r in default_conformance_rules()}
+        assert set(rules) == {"accuracy_burn", "canary_mismatch"}
+        assert rules["accuracy_burn"].series == "solve_inaccurate_total"
+        assert rules["canary_mismatch"].series == "canary_mismatch_total"
+        for r in rules.values():
+            assert r.kind == "rate" and r.bound == 0.0
+
+
+# ---------------------------------------------------------------------
+# bitwise neutrality at the adaptive entry points
+# ---------------------------------------------------------------------
+class TestAdaptiveNeutrality:
+    def test_dense_batch_bitwise_and_summary(self):
+        reset_metrics()
+        lp = _stack([_lp(s) for s in (20, 21, 22, 23)])
+        ref = solve_lp_adaptive(lp, chunk_iters=3, ladder_base=4, **KW)
+        stats = {}
+        out = solve_lp_adaptive(
+            lp, chunk_iters=3, ladder_base=4, conformance=True, stats=stats,
+            **KW,
+        )
+        _assert_bitwise(ref, out)
+        conf = stats["conformance"]
+        assert conf["entry"] == "solve_lp"
+        assert len(conf["lanes"]) == 4
+        assert conf["ok"] and all(ln["ok"] for ln in conf["lanes"])
+        assert set(conf["worst"]) == set(FIELDS)
+        snap = obs_metrics.snapshot()
+        assert _hist_count(snap, "solve_residual_primal", entry="solve_lp") == 4
+        assert _counter(snap, "solve_conformance_total",
+                        entry="solve_lp", outcome="pass") == 4
+
+    def test_dense_unbatched_bitwise(self):
+        one = _lp(30)
+        ref = solve_lp_adaptive(one, **KW)
+        stats = {}
+        out = solve_lp_adaptive(one, conformance=True, stats=stats, **KW)
+        _assert_bitwise(ref, out)
+        assert len(stats["conformance"]["lanes"]) == 1
+        assert stats["conformance"]["ok"]
+
+    def test_banded_bitwise(self):
+        from dispatches_tpu.case_studies.renewables import params as P
+        from dispatches_tpu.case_studies.renewables.pricetaker import (
+            HybridDesign,
+            build_pricetaker,
+        )
+        from dispatches_tpu.solvers.structured import (
+            BandedLP,
+            extract_time_structure,
+        )
+
+        Tb = 24
+        design = HybridDesign(
+            T=Tb, with_battery=True, with_pem=True, design_opt=True,
+            h2_price_per_kg=2.5, initial_soc_fixed=None,
+        )
+        prog, _ = build_pricetaker(design)
+        meta = extract_time_structure(prog, Tb, block_hours=12)
+        data = P.load_rts303()
+        lmp = jnp.asarray(data["da_lmp"][:Tb], jnp.float64)
+        cf = jnp.asarray(data["da_wind_cf"][:Tb], jnp.float64)
+        rows = [
+            meta.instantiate({"lmp": lmp * s, "wind_cf": cf})
+            for s in (0.9, 1.1)
+        ]
+        blp = BandedLP(*(
+            jnp.stack([jnp.asarray(r[i]) for r in rows])
+            for i in range(len(rows[0]))
+        ))
+        # chunk_iters = max_iter: a single chunk, no resume recompiles
+        ref = solve_lp_banded_adaptive(
+            meta, blp, chunk_iters=60, ladder_base=2, **KW
+        )
+        stats = {}
+        out = solve_lp_banded_adaptive(
+            meta, blp, chunk_iters=60, ladder_base=2, conformance=True,
+            stats=stats, **KW,
+        )
+        _assert_bitwise(ref, out)
+        conf = stats["conformance"]
+        assert conf["entry"] == "solve_lp_banded"
+        assert len(conf["lanes"]) == 2
+        assert all(np.isfinite(v) for v in conf["worst"].values())
+        assert conf["ok"]
+
+    def test_pdhg_bitwise(self):
+        lp = _lp(40)
+        A = np.asarray(lp.A)
+        r_, c_ = np.nonzero(A)
+        r = np.random.default_rng(41)
+        lps = SparseLP(
+            rows=jnp.asarray(r_, jnp.int32), cols=jnp.asarray(c_, jnp.int32),
+            vals=jnp.asarray(A[r_, c_]), b=lp.b,
+            c=jnp.stack([lp.c, jnp.asarray(r.normal(size=lp.c.shape[0]))]),
+            l=lp.l, u=lp.u, c0=jnp.asarray([0.0, 0.0]),
+        )
+        kw = dict(tol=1e-5, max_iter=2000, check_every=100)
+        ref = solve_lp_pdhg_adaptive(lps, chunk_iters=500, ladder_base=2, **kw)
+        stats = {}
+        out = solve_lp_pdhg_adaptive(
+            lps, chunk_iters=500, ladder_base=2, conformance=True,
+            stats=stats, **kw,
+        )
+        _assert_bitwise(ref, out)
+        conf = stats["conformance"]
+        assert conf["entry"] == "solve_lp_pdhg"
+        assert len(conf["lanes"]) == 2
+        assert all(np.isfinite(v) for v in conf["worst"].values())
+
+
+# ---------------------------------------------------------------------
+# the serving hooks
+# ---------------------------------------------------------------------
+class TestServicePlane:
+    def _solve_all(self, svc, seeds):
+        tickets = [
+            svc.submit(_lp(s), request_id=f"r{s}") for s in seeds
+        ]
+        svc.drain(timeout=600.0)
+        return [t.result(timeout=60.0) for t in tickets]
+
+    def test_service_bitwise_and_checked(self):
+        reset_metrics()
+        seeds = (50, 51, 52, 53)
+        off = self._solve_all(
+            make_dense_service(4, cache_size=None, **KW), seeds
+        )
+        on_svc = make_dense_service(
+            4, cache_size=None, conformance=True, **KW
+        )
+        on = self._solve_all(on_svc, seeds)
+        for a, b in zip(off, on):
+            assert a.verdict == b.verdict
+            _assert_bitwise(a.solution, b.solution)
+        rep = on_svc.conformance_report()["conformance"]
+        assert rep["checked"] == 4
+        assert rep["outcomes"] == {"pass": 4}
+        snap = obs_metrics.snapshot()
+        assert _hist_count(
+            snap, "solve_residual_primal", entry="serve_dense"
+        ) == 4
+        assert _counter(snap, "solve_inaccurate_total", entry="serve_dense") == 0
+
+    def test_strict_policy_flags_inaccurate_without_blocking(self):
+        reset_metrics()
+        seeds = (50, 51, 52, 53)
+        ref = self._solve_all(
+            make_dense_service(4, cache_size=None, **KW), seeds
+        )
+        strict = ConformancePolicy(
+            res_primal=1e-30, res_dual=1e-30, comp=1e-30, gap=1e-30
+        )
+        svc = make_dense_service(4, cache_size=None, conformance=strict, **KW)
+        out = self._solve_all(svc, seeds)
+        for a, b in zip(ref, out):
+            # the plane observes and escalates — it never blocks or edits
+            assert b.verdict == "inaccurate"
+            _assert_bitwise(a.solution, b.solution)
+        snap = obs_metrics.snapshot()
+        assert _counter(snap, "solve_inaccurate_total", entry="serve_dense") == 4
+        rep = svc.conformance_report()["conformance"]
+        assert rep["outcomes"] == {"inaccurate": 4}
+
+
+# ---------------------------------------------------------------------
+# golden artifacts
+# ---------------------------------------------------------------------
+class TestGoldens:
+    def test_certify_save_load_roundtrip(self, tmp_path):
+        g = certify_golden("g0", _lp(60), tol=1e-6, max_iter=200)
+        assert g.family == "dense" and g.x_ref.shape == (8,)
+        path = str(tmp_path / "goldens.npz")
+        save_goldens(path, [g])
+        (loaded,) = load_goldens(path)
+        assert loaded.name == "g0" and loaded.family == "dense"
+        assert loaded.fingerprint == g.fingerprint
+        assert loaded.tol == g.tol and loaded.obj_ref == g.obj_ref
+        assert np.array_equal(loaded.x_ref, g.x_ref)
+        for a, b in zip(loaded.problem, g.problem):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_uncertifiable_reference_refused(self):
+        with pytest.raises(ValueError, match="not certifiable"):
+            certify_golden("bad", _lp(61), certify_tol=1e-9, max_iter=2)
+
+    def test_save_refuses_empty_and_duplicates(self, tmp_path):
+        g = certify_golden("g0", _lp(60), max_iter=200)
+        with pytest.raises(ValueError, match="empty golden set"):
+            save_goldens(str(tmp_path / "e.npz"), [])
+        with pytest.raises(ValueError, match="duplicate golden names"):
+            save_goldens(str(tmp_path / "d.npz"), [g, g])
+
+    def test_refuse_to_load(self, tmp_path):
+        g = certify_golden("g0", _lp(60), max_iter=200)
+        path = str(tmp_path / "goldens.npz")
+        save_goldens(path, [g])
+
+        # not an artifact at all
+        no_manifest = str(tmp_path / "junk.npz")
+        np.savez(no_manifest, foo=np.zeros(3))
+        with pytest.raises(CanaryArtifactMismatch, match="no manifest"):
+            load_goldens(no_manifest)
+
+        # version skew
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        manifest = json.loads(str(arrays["__manifest__"]))
+        manifest["version"] = 99
+        skew = dict(arrays, __manifest__=np.asarray(json.dumps(manifest)))
+        skew_path = str(tmp_path / "skew.npz")
+        np.savez(skew_path, **skew)
+        with pytest.raises(CanaryArtifactMismatch, match="version"):
+            load_goldens(skew_path)
+
+        # tampered problem content: the fingerprint is recomputed on load
+        tampered = dict(arrays)
+        tampered["g0/c"] = arrays["g0/c"] + 1e-3
+        tam_path = str(tmp_path / "tampered.npz")
+        np.savez(tam_path, **tampered)
+        with pytest.raises(CanaryArtifactMismatch, match="fingerprint"):
+            load_goldens(tam_path)
+
+        # family filter
+        with pytest.raises(CanaryArtifactMismatch, match="family"):
+            load_goldens(path, expect_family="pdhg")
+
+
+# ---------------------------------------------------------------------
+# the canary scheduler
+# ---------------------------------------------------------------------
+class TestCanaryScheduler:
+    def test_round_scores_pass_and_mismatch(self, tmp_path):
+        reset_metrics()
+        good = certify_golden("good", _lp(70), tol=1e-6, max_iter=200)
+        # a tampered reference: the serve answer is right, the frozen
+        # "truth" is wrong — exactly what a mismatch must catch
+        bad = good._replace(name="bad", x_ref=good.x_ref + 1.0)
+        svc = make_dense_service(4, cache_size=None, max_iter=200)
+        jpath = str(tmp_path / "canary.jsonl")
+        tracer = Tracer(jpath)
+        with use_tracer(tracer):
+            sched = CanaryScheduler(
+                [good, bad], every_s=0.0, service=svc, clock=lambda: 0.0
+            )
+            assert sched.due()
+            assert sched.inject() == 2
+            assert not sched.due()  # one round in flight at a time
+            svc.drain(timeout=600.0)
+            scored = sched.collect()
+        tracer.close()
+        by_name = {r["golden"]: r for r in scored}
+        assert by_name["good"]["outcome"] in ("exact", "tolerance")
+        assert by_name["good"]["rel_x"] <= good.tol
+        assert by_name["bad"]["outcome"] == "mismatch"
+        assert by_name["bad"]["rel_x"] > bad.tol
+        assert sched.rounds == 1 and sched.mismatches == 1
+        rep = sched.report()
+        assert rep["pending"] == 0
+        assert rep["goldens"]["bad"]["outcome"] == "mismatch"
+        snap = obs_metrics.snapshot()
+        assert _counter(snap, "canary_mismatch_total", golden="bad") == 1
+        assert _counter(snap, "canary_mismatch_total", golden="good") == 0
+        assert _counter(snap, "canary_pass_total", golden="good") == 1
+        # probe verdicts land as canary journal events
+        events = [
+            r for r in read_journal(jpath)
+            if r.get("kind") == "event" and r.get("name") == "canary"
+        ]
+        assert {e["golden"] for e in events} == {"good", "bad"}
+        assert all(e["scheduler"] == "canary" for e in events)
+
+    def test_unanswered_probe_is_inconclusive(self):
+        reset_metrics()
+        g = certify_golden("g0", _lp(71), max_iter=200)
+        sched = CanaryScheduler([g], service=object())
+        rec = sched._score(
+            g, SimpleNamespace(solution=None, verdict="shed"), 0
+        )
+        assert rec["outcome"] == "inconclusive"
+        assert sched.mismatches == 0
+        snap = obs_metrics.snapshot()
+        assert _counter(snap, "canary_inconclusive_total", golden="g0") == 1
+
+    def test_needs_goldens_and_service(self):
+        with pytest.raises(ValueError, match="at least one golden"):
+            CanaryScheduler([])
+        g = certify_golden("g0", _lp(71), max_iter=200)
+        with pytest.raises(RuntimeError, match="no attached service"):
+            CanaryScheduler([g]).inject()
+
+
+# ---------------------------------------------------------------------
+# the fleet hook: conformance + canary through router -> shard -> engine
+# ---------------------------------------------------------------------
+class TestFleetPlane:
+    def test_fleet_canary_round_and_report(self, tmp_path):
+        from dispatches_tpu.serve import make_dense_fleet
+
+        reset_metrics()
+        goldens = [
+            certify_golden(f"g{i}", _lp(80 + i), tol=1e-6, max_iter=200)
+            for i in range(2)
+        ]
+        path = str(tmp_path / "goldens.npz")
+        save_goldens(path, goldens)
+        fleet = make_dense_fleet(
+            1, 4, cache_size=None, conformance=True, canary=path,
+            solver_kw={"max_iter": 200},
+        )
+        try:
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                fleet.pump()
+                if fleet.canary.rounds >= 1 and not fleet.canary._pending:
+                    break
+                time.sleep(0.02)
+            rep = fleet.conformance_report()
+            canary = rep["canary"]
+            assert canary["rounds"] >= 1 and canary["pending"] == 0
+            assert canary["mismatches"] == 0
+            for name, last in canary["goldens"].items():
+                assert last is not None, name
+                assert last["outcome"] in ("exact", "tolerance"), last
+            conf = rep["conformance"]
+            assert conf["checked"] >= 2  # at least the canary probes
+            assert set(conf["outcomes"]) == {"pass"}
+        finally:
+            fleet.close()
+        snap = obs_metrics.snapshot()
+        assert _counter(snap, "canary_mismatch_total") == 0
+        assert _counter(snap, "canary_pass_total") >= 2

@@ -404,6 +404,27 @@ class TestTraceSummaryMixedSchema:
                                 "compile_hits": 1, "compile_misses": 1},
              "health": {"counts": {"healthy": 4}, "n_bad": 0,
                         "worst": {"lane": 0, "verdict": "healthy"}}},
+            # schema-v5 conformance attr: KKT columns + footer, and a
+            # degenerate one (non-numeric residuals must not kill render)
+            {"kind": "solve", "ts": 3.2, "name": "conf_style",
+             "stats": {"batch": 2, "converged_frac": 1.0,
+                       "iterations": {"min": 4, "max": 6, "median": 5}},
+             "conformance": {"res_primal": 1.5e-9, "res_dual": 2.0e-10,
+                             "comp": 1e-11, "gap": 3.0e-11,
+                             "outcome": "pass", "ok": True}},
+            {"kind": "solve", "ts": 3.3, "name": "conf_bad",
+             "stats": {"batch": 1, "converged_frac": 0.0,
+                       "iterations": {"min": 60, "max": 60, "median": 60}},
+             "conformance": {"res_primal": "nan", "res_dual": 0.5,
+                             "comp": None, "gap": 0.7,
+                             "outcome": "fail", "ok": False}},
+            {"kind": "event", "ts": 3.4, "name": "canary",
+             "scheduler": "canary", "golden": "g0", "round": 1,
+             "verdict": "healthy", "outcome": "exact"},
+            {"kind": "event", "ts": 3.5, "name": "canary",
+             "scheduler": "canary", "golden": "g1", "round": 1,
+             "verdict": "healthy", "outcome": "mismatch",
+             "rel_x": 4.2e-4, "rel_obj": 1e-5},
             {"kind": "close", "ts": 4.0, "retrace_totals": {}},
         ]
         path = tmp_path / "mixed.jsonl"
@@ -416,6 +437,50 @@ class TestTraceSummaryMixedSchema:
         assert "odd_stats" in out
         assert "unrenderable solve record" in out  # hostile degraded, not fatal
         assert "new_style" in out and "verdict=healthy" in out
+        # pre-v5 solve lines carry NO kkt column
+        assert "old_style: batch=8" in out
+        for ln in out.splitlines():
+            if "old_style" in ln or "new_style" in ln:
+                assert "kkt[" not in ln
+        # v5 lines and footer
+        assert "kkt[rp=1.5e-09 rd=2.0e-10 gap=3.0e-11]" in out
+        assert "kkt[rp=? rd=5.0e-01 gap=7.0e-01 FAIL]" in out
+        assert "conformance conf_style: 1 checked, all pass" in out
+        assert "conformance conf_bad: 1 checked, 1 INACCURATE" in out
+        assert "canary: 2 probes (exact=1, mismatch=1)" in out
+        assert "MISMATCH g1 rel_x=4.2e-04" in out
+        # canary probe verdicts do NOT inflate the health footer
+        assert "healthy=4" in out and "healthy=5" not in out
+
+    def test_pre_v5_fixture_renders_without_conformance(self, tmp_path,
+                                                        capsys):
+        """A journal with no conformance attrs and no canary events gets
+        neither kkt columns nor the conformance footer."""
+        recs = [
+            {"kind": "manifest", "schema_version": 4, "run_id": "old",
+             "git_sha": "beef", "platform": "cpu"},
+            {"kind": "solve", "ts": 1.0, "name": "plain",
+             "stats": {"batch": 4, "converged_frac": 1.0,
+                       "iterations": {"min": 3, "max": 9, "median": 5}}},
+            {"kind": "close", "ts": 2.0, "retrace_totals": {}},
+        ]
+        path = tmp_path / "old.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        ts = importlib.import_module("tools.trace_summary")
+        assert ts.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "plain: batch=4" in out
+        assert "kkt[" not in out
+        assert "conformance" not in out
+        assert "canary" not in out
+
+    def test_severity_mirror_matches_health(self):
+        """trace_summary keeps a local copy of the verdict order so it
+        never imports jax-adjacent packages — hold the two together."""
+        from dispatches_tpu.obs.health import SEVERITY
+
+        ts = importlib.import_module("tools.trace_summary")
+        assert tuple(ts._SEVERITY) == tuple(SEVERITY)
 
     def test_journal_diff_goodput_direction(self):
         jd = importlib.import_module("tools.journal_diff")

@@ -22,6 +22,14 @@ Two sources:
   snapshot JSON (an exporter ``/snapshot`` capture, or the ``metrics``
   field of a journal's close record).
 
+When the fleet runs the numerical conformance plane
+(docs/observability.md §12), a conformance panel appears under the
+table: worst residual p95 per entry from the ``solve_residual_*``
+histograms (with checked/inaccurate counts), a per-golden canary status
+glyph (``✓`` passing / ``✗`` MISMATCH / ``?`` inconclusive), and — in
+live mode with a store attached — sparklines of the retained
+``solve_residual_*_p95`` tracks. Plane-off fleets show no panel.
+
 Stdlib-only on purpose (same contract as journal_diff/trace_timeline):
 pointing this at a production fleet must not import jax. The series
 parser and histogram quantile mirror `obs.metrics` exactly —
@@ -118,18 +126,25 @@ def hist_quantile(h: Dict[str, Any], q: float) -> Optional[float]:
 # snapshot -> per-shard rows
 
 
+def _by_label(
+    snap: Dict[str, Any], kind: str, name: str, label: str
+) -> Dict[str, float]:
+    """Sum every `kind` series named `name` per `label` value."""
+    out: Dict[str, float] = {}
+    for series, v in (snap.get(kind) or {}).items():
+        n, labels = parse_series(series)
+        if n != name or label not in labels:
+            continue
+        val = float(v["count"]) if isinstance(v, dict) else float(v)
+        out[labels[label]] = out.get(labels[label], 0.0) + val
+    return out
+
+
 def _by_shard(
     snap: Dict[str, Any], kind: str, name: str
 ) -> Dict[str, float]:
     """Sum every `kind` series named `name` per ``shard`` label value."""
-    out: Dict[str, float] = {}
-    for series, v in (snap.get(kind) or {}).items():
-        n, labels = parse_series(series)
-        if n != name or "shard" not in labels:
-            continue
-        val = float(v["count"]) if isinstance(v, dict) else float(v)
-        out[labels["shard"]] = out.get(labels["shard"], 0.0) + val
-    return out
+    return _by_label(snap, kind, name, "shard")
 
 
 def _shard_hist(
@@ -257,6 +272,55 @@ def spark_lines(queries: Dict[str, Optional[Dict[str, Any]]]) -> List[str]:
     return lines
 
 
+def conformance_lines(snap: Dict[str, Any]) -> List[str]:
+    """The conformance panel (docs/observability.md §12): worst residual
+    p95 per entry from the ``solve_residual_*`` histograms plus
+    checked/inaccurate counts, and one canary status glyph per golden
+    from the ``canary_*_total`` counters. Empty (no panel) when the
+    fleet runs without the plane — no such series exist at all."""
+    worst: Dict[str, Tuple[str, float]] = {}
+    for series, h in (snap.get("histograms") or {}).items():
+        name, labels = parse_series(series)
+        if not name.startswith("solve_residual_") or "entry" not in labels:
+            continue
+        p = hist_quantile(h, 0.95)
+        if p is None:
+            continue
+        field = name[len("solve_residual_"):]
+        cur = worst.get(labels["entry"])
+        if cur is None or p > cur[1]:
+            worst[labels["entry"]] = (field, p)
+    checked = _by_label(snap, "counters", "solve_conformance_total", "entry")
+    inaccurate = _by_label(snap, "counters", "solve_inaccurate_total", "entry")
+    lines: List[str] = []
+    for entry in sorted(set(worst) | set(checked) | set(inaccurate)):
+        bits = [f"  {entry:<20}"]
+        w = worst.get(entry)
+        if w is not None:
+            bits.append(f"worst p95 {w[0]}={w[1]:.1e}")
+        if entry in checked:
+            bits.append(f"checked={int(checked[entry])}")
+        bad = int(inaccurate.get(entry, 0))
+        bits.append(f"INACCURATE={bad}" if bad else "inaccurate=0")
+        lines.append("  ".join(bits))
+    passes = _by_label(snap, "counters", "canary_pass_total", "golden")
+    mism = _by_label(snap, "counters", "canary_mismatch_total", "golden")
+    inconc = _by_label(
+        snap, "counters", "canary_inconclusive_total", "golden")
+    goldens = sorted(set(passes) | set(mism) | set(inconc))
+    if goldens:
+        bits = []
+        for g in goldens:
+            if mism.get(g):
+                bits.append(f"{g} ✗ MISMATCH={int(mism[g])}")
+            elif passes.get(g):
+                bits.append(f"{g} ✓ pass={int(passes[g])}")
+            else:
+                bits.append(f"{g} ? inconclusive={int(inconc.get(g, 0))}")
+        lines.append("  canary  " + "  ".join(bits))
+    return ["conformance"] + lines if lines else []
+
+
 def alert_lines(alerts: Optional[Dict[str, Any]]) -> List[str]:
     """The firing-alerts panel from an ``/alerts`` report: one row per
     firing instance, plus a one-line OK when the pack is quiet."""
@@ -334,6 +398,7 @@ def render(
             for name, s in sorted(slo["slos"].items())
         ]
         lines.append("burn rates  " + "  ".join(parts))
+    lines.extend(conformance_lines(snap))
     if queries:
         sl = spark_lines(queries)
         if sl:
@@ -377,10 +442,15 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
         # perf_mxu_utilization is the PerfProbe's measured-roofline gauge
         # (obs/perf.py): sampled into the store like any registry gauge,
         # absent (and dropped below) when no probe is attached
+        # solve_residual_*_p95 are the store's retained quantile tracks
+        # auto-derived from the conformance histograms (obs/timeseries.py):
+        # absent (and dropped below) when the plane is off
         queries = {
             name: _get_json(url + f"/query?name={name}&window=300")
             for name in ("serve_queue_depth", "serve_shard_inflight",
-                         "perf_mxu_utilization")
+                         "perf_mxu_utilization",
+                         "solve_residual_primal_p95",
+                         "solve_residual_gap_p95")
         }
         queries = {k: v for k, v in queries.items()
                    if v and not v.get("error")}
@@ -571,6 +641,56 @@ def self_check() -> int:
     check("render shows DOWN shard", "DOWN" in out, out)
     check("render shows fleet aggregate row", "fleet" in out and "15" in out)
     check("render shows burn rates", "1.25" in out)
+
+    # conformance panel: worst residual p95 per entry, canary glyphs,
+    # and no panel at all for a plane-off snapshot
+    check(
+        "plane-off snapshot renders no conformance panel",
+        conformance_lines(snap) == [],
+    )
+    csnap = json.loads(json.dumps(snap))
+    csnap["counters"].update({
+        'solve_conformance_total{entry="serve_fleet",outcome="pass"}': 40,
+        'solve_inaccurate_total{entry="serve_fleet"}': 0,
+        'solve_conformance_total{entry="serve_dense",outcome="fail_gap"}': 2,
+        'solve_inaccurate_total{entry="serve_dense"}': 2,
+        'canary_pass_total{golden="g0",outcome="exact"}': 12,
+        'canary_mismatch_total{golden="g1"}': 3,
+        'canary_inconclusive_total{golden="g2"}': 1,
+    })
+    csnap["histograms"].update({
+        'solve_residual_gap{entry="serve_fleet"}': {
+            "count": 40, "sum": 1e-8,
+            "buckets": {"1e-09": 38, "1e-06": 2, "+Inf": 0},
+        },
+        'solve_residual_primal{entry="serve_fleet"}': {
+            "count": 40, "sum": 1e-8,
+            "buckets": {"1e-09": 40, "+Inf": 0},
+        },
+    })
+    cl = conformance_lines(csnap)
+    check(
+        "conformance panel: worst residual p95 per entry",
+        any("serve_fleet" in x and "worst p95 gap=" in x
+            and "checked=40" in x for x in cl),
+        str(cl),
+    )
+    check(
+        "conformance panel: inaccurate count surfaced",
+        any("serve_dense" in x and "INACCURATE=2" in x for x in cl),
+        str(cl),
+    )
+    canary_row = next((x for x in cl if "canary" in x), "")
+    check(
+        "canary glyphs: pass / mismatch / inconclusive",
+        "g0 ✓ pass=12" in canary_row and "g1 ✗ MISMATCH=3" in canary_row
+        and "g2 ? inconclusive=1" in canary_row,
+        canary_row,
+    )
+    check(
+        "render appends conformance panel",
+        "conformance" in render(csnap) and "conformance" not in render(snap),
+    )
 
     # qps from a counter delta between two polls
     prev = json.loads(json.dumps(snap))

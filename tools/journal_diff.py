@@ -66,6 +66,13 @@ _HIGHER_IS_BETTER = (
     # an alert that fired and resolved is a recovery; fired_total and the
     # alerts_firing steady-state gauge fall through to lower-is-better
     "alerts_resolved",
+    # conformance plane (obs/conformance.py + serve/canary.py): canary
+    # passes and outcome="pass" certificate counts are the good half;
+    # solve_residual_* p95s, solve_inaccurate_total, and
+    # canary_mismatch_total all fall through to lower-is-better (a
+    # residual creeping up or a mismatch appearing is an accuracy
+    # regression even when every latency held)
+    "canary_pass", 'outcome="pass"',
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
@@ -118,6 +125,16 @@ _ZERO_SEEDED = (
     # the closing quote intervenes — so those histograms stay
     # lower-is-better, as a latency should.)
     "compile_cache_miss_total", "compile_cache_hit_total",
+    # conformance plane: inaccurate verdicts and canary mismatches only
+    # exist once a certificate failed or a golden probe came back wrong —
+    # a clean baseline has no such series, so they gate
+    # appearing-from-zero. Passes zero-seed too but, as higher-is-better,
+    # only gate on a same-workload DROP (canary stopped passing / the
+    # checker stopped certifying), never on the plane being switched on
+    # against a plane-off baseline.
+    "solve_inaccurate_total", "solve_conformance_total",
+    "canary_mismatch_total", "canary_pass_total",
+    "canary_inconclusive_total",
 )
 
 
@@ -308,9 +325,13 @@ def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
                     # serve-tier latencies, compile_seconds (a compile
                     # getting slower is a gateable latency), and the
                     # perf probe's phase/chunk walls all diff as p95s
+                    # solve_residual_* (obs/conformance.py) diff as p95s
+                    # too: a residual distribution shifting up is an
+                    # accuracy regression
                     if (series.startswith("serve_")
                             or series.startswith("compile_seconds")
-                            or series.startswith("perf_")):
+                            or series.startswith("perf_")
+                            or series.startswith("solve_residual_")):
                         p = _hist_p95(h)
                         if p is not None:
                             out[f"metric/{series}/p95"] = p
@@ -925,6 +946,70 @@ def self_check(out=sys.stdout) -> int:
         "probe-on run vs probe-off baseline passes "
         "(perf_* volume counters are not zero-seeded)",
         False, any(r["regression"] for r in rows)))
+
+    # conformance plane (obs/conformance.py + serve/canary.py): residual
+    # p95s (histogram snapshots AND retained quantile tracks) gate
+    # lower-is-better, inaccurate verdicts and canary mismatches gate
+    # appearing-from-zero, canary passes gate on a same-workload drop
+    cbase = {
+        'metric/solve_residual_gap{entry="serve_fleet"}/p95': 1e-9,
+        'metric/solve_residual_primal_p95{entry="serve_fleet"}': 2e-10,
+        'metric/solve_conformance_total{entry="serve_fleet",outcome="pass"}':
+        40.0,
+        'metric/canary_pass_total{golden="g0",outcome="exact"}': 12.0,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def crun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(cbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    crun("identical conformance metrics pass", dict(cbase), False)
+    crun("residual-gap p95 regression >10% fails (lower is better)",
+         {**cbase,
+          'metric/solve_residual_gap{entry="serve_fleet"}/p95': 1e-6}, True)
+    crun("residual-gap p95 improving passes",
+         {**cbase,
+          'metric/solve_residual_gap{entry="serve_fleet"}/p95': 1e-11},
+         False)
+    crun("retained residual p95 track regression fails (lower is better)",
+         {**cbase,
+          'metric/solve_residual_primal_p95{entry="serve_fleet"}': 5e-8},
+         True)
+    crun("inaccurate verdicts appearing from zero fail (zero-seeded)",
+         {**cbase,
+          'metric/solve_inaccurate_total{entry="serve_fleet"}': 2.0}, True)
+    crun("canary mismatch appearing from zero fails (zero-seeded)",
+         {**cbase,
+          'metric/canary_mismatch_total{golden="g0"}': 1.0}, True)
+    crun("canary pass count dropping >10% fails (higher is better)",
+         {**cbase,
+          'metric/canary_pass_total{golden="g0",outcome="exact"}': 6.0},
+         True)
+    crun("canary pass count growing passes",
+         {**cbase,
+          'metric/canary_pass_total{golden="g0",outcome="exact"}': 24.0},
+         False)
+    crun("certificate pass count dropping >10% fails "
+         "(checker stopped certifying)",
+         {**cbase,
+          'metric/solve_conformance_total{entry="serve_fleet",outcome="pass"}':
+          20.0}, True)
+    cleanc = {"serve/loadgen/goodput_rps": 120.0}
+    rows = compare(cleanc, {k: v for k, v in cbase.items()})
+    checks.append((
+        "plane-on run vs plane-off baseline with zero mismatches passes "
+        "(pass counters are higher-is-better, residual p95s uncompared)",
+        False, any(r["regression"] for r in rows)))
+    rows = compare(cleanc, {
+        **cleanc,
+        'metric/solve_conformance_total{entry="serve_fleet",outcome="fail_gap"}':
+        3.0,
+    })
+    checks.append((
+        "failed certificates appearing vs plane-off baseline fail "
+        "(non-pass outcomes are zero-seeded lower-is-better)",
+        True, any(r["regression"] for r in rows)))
 
     ok = True
     for name, want, got in checks:

@@ -178,6 +178,16 @@ def main(argv=None, out=sys.stdout) -> int:
                     help="learned warm-start artifact (.npz from "
                     "tools/train_warmstart.py); seeds fresh lanes through "
                     "the solver safeguard — docs/learned_warmstarts.md")
+    ap.add_argument("--conformance", action="store_true",
+                    help="compute per-solve KKT certificates at harvest, "
+                    "escalate failures to the `inaccurate` verdict, and "
+                    "(with --timeseries) arm the accuracy alert pack; "
+                    "adds /conformance to the exporter — "
+                    "docs/observability.md §12")
+    ap.add_argument("--canary", default=None,
+                    help="--shards mode: goldens .npz (from "
+                    "tools/canary_report.py --certify) injected through "
+                    "the full router->shard path on a cadence")
     args = ap.parse_args(argv)
 
     import jax
@@ -236,6 +246,8 @@ def main(argv=None, out=sys.stdout) -> int:
                                 ),
                                 warm_model=args.warm_model,
                                 timeseries=args.timeseries,
+                                conformance=args.conformance or None,
+                                canary=args.canary,
                                 solver_kw={"max_iter": args.max_iter},
                             )
                         else:
@@ -247,6 +259,7 @@ def main(argv=None, out=sys.stdout) -> int:
                                 reqtrace=args.reqtrace,
                                 warm_model=args.warm_model,
                                 timeseries=args.timeseries,
+                                conformance=args.conformance or None,
                             )
                         svc.start()
                         if exporter is not None and args.timeseries:
@@ -255,6 +268,10 @@ def main(argv=None, out=sys.stdout) -> int:
                             # attributes per request
                             exporter.store = svc.store
                             exporter.alerts = getattr(svc, "alerts", None)
+                        if exporter is not None and args.conformance:
+                            exporter.conformance_fn = getattr(
+                                svc, "conformance_report", None
+                            )
                     kw = {}
                     if args.shards > 0:
                         kw["tenant"] = req.get("tenant", "default")

@@ -29,6 +29,12 @@ manifest record). For each run this prints:
   compile p95) from the close snapshot's ``perf_*``/``compile_seconds``
   histograms — pre-v4 journals and probe-off runs render exactly as
   before;
+- when the run holds schema-v5 conformance attrs (an `obs.conformance`
+  checker was attached), per-solve KKT residual/gap columns on the solve
+  lines, a per-family conformance footer (checked/pass/fail counts and
+  worst residuals per entry), and a canary ledger from ``canary``
+  events (per-outcome counts plus any mismatched goldens) — pre-v5
+  journals and plane-off runs render exactly as before;
 - cumulative retrace counts from the close record (or summed span deltas
   for a run that died before closing).
 
@@ -87,7 +93,7 @@ def _split_runs(events: List[dict]) -> List[List[dict]]:
 # verdict badness order, mirrored from obs.health.SEVERITY (kept local so
 # summarizing a journal never needs to import jax-adjacent packages)
 _SEVERITY = (
-    "healthy", "slow", "cycling", "stalled",
+    "healthy", "slow", "inaccurate", "cycling", "stalled",
     "deadline_exceeded", "shed", "shed_tenant_quota", "poisoned",
     "diverged", "nonfinite", "unrecoverable", "hang", "failed",
 )
@@ -205,6 +211,25 @@ def _fmt_phases(phases) -> str:
     return f" [{' '.join(bits)}]" if bits else ""
 
 
+def _fmt_res(v) -> str:
+    return f"{float(v):.1e}" if isinstance(v, (int, float)) else "?"
+
+
+def _fmt_kkt(conf: dict) -> str:
+    """Residual/gap columns for a solve line from a conformance attr
+    ({res_primal, res_dual, comp, gap, outcome, ok}); the outcome tag
+    only appears when the certificate failed its policy."""
+    bits = [
+        f"rp={_fmt_res(conf.get('res_primal'))}",
+        f"rd={_fmt_res(conf.get('res_dual'))}",
+        f"gap={_fmt_res(conf.get('gap'))}",
+    ]
+    outcome = conf.get("outcome")
+    if outcome and outcome != "pass":
+        bits.append(str(outcome).upper())
+    return f" kkt[{' '.join(bits)}]"
+
+
 def _print_solves(run: List[dict], out) -> None:
     solves = [e for e in run if e.get("kind") == "solve"]
     if not solves:
@@ -291,6 +316,11 @@ def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
     health = ev.get("health")
     if isinstance(health, dict):
         line += _fmt_verdict(health)
+    # schema-v5 conformance attr (obs/conformance.py): KKT certificate
+    # columns. Journals predating the plane render exactly as before.
+    conf = ev.get("conformance")
+    if isinstance(conf, dict):
+        line += _fmt_kkt(conf)
     print(line, file=out)
     if it.get("hist"):
         print(f"      hist: {_fmt_hist(it['hist'])}", file=out)
@@ -338,6 +368,8 @@ def _print_health_footer(run: List[dict], out) -> None:
         elif ev.get("kind") == "event":
             if ev.get("name") == "capture":
                 continue  # echoes a verdict already counted at its solve
+            if ev.get("name") == "canary":
+                continue  # probe verdicts land in the conformance footer
             v = None
             if ev.get("name") == "hang":
                 v = "hang"
@@ -387,6 +419,72 @@ def _print_warm_footer(run: List[dict], out) -> None:
         for src, (n, acc) in sorted(per_src.items())
     )
     print(f"  warm starts: {txt}", file=out)
+
+
+def _print_conformance_footer(run: List[dict], out) -> None:
+    """Per-family conformance aggregate: checked/pass/fail counts and
+    worst residuals per solve-record name (the entry that harvested the
+    certificate), plus a canary ledger from ``canary`` events — probe
+    counts per outcome and any mismatched goldens. Silent for pre-v5
+    journals and plane-off runs (no attrs, no events, no footer)."""
+    per: dict = {}
+    for ev in run:
+        if ev.get("kind") != "solve" or not isinstance(
+            ev.get("conformance"), dict
+        ):
+            continue
+        conf = ev["conformance"]
+        d = per.setdefault(
+            str(ev.get("name") or "?"), {"n": 0, "fail": 0, "worst": {}}
+        )
+        d["n"] += 1
+        if not conf.get("ok", True):
+            d["fail"] += 1
+        for k in ("res_primal", "res_dual", "comp", "gap"):
+            v = conf.get(k)
+            if isinstance(v, (int, float)) and (
+                k not in d["worst"] or v > d["worst"][k]
+            ):
+                d["worst"][k] = float(v)
+    for name in sorted(per):
+        d = per[name]
+        worst = " ".join(
+            f"{k}={d['worst'][k]:.1e}"
+            for k in ("res_primal", "res_dual", "comp", "gap")
+            if k in d["worst"]
+        )
+        status = f"{d['fail']} INACCURATE" if d["fail"] else "all pass"
+        print(
+            f"  conformance {name}: {d['n']} checked, {status}"
+            + (f" (worst {worst})" if worst else ""),
+            file=out,
+        )
+    cans = [e for e in run
+            if e.get("kind") == "event" and e.get("name") == "canary"]
+    if not cans:
+        return
+    outcomes: dict = {}
+    bad: dict = {}
+    for ev in cans:
+        o = str(ev.get("outcome") or "?")
+        outcomes[o] = outcomes.get(o, 0) + 1
+        if o == "mismatch":
+            g = str(ev.get("golden") or "?")
+            rx = ev.get("rel_x")
+            if g not in bad or (
+                isinstance(rx, (int, float))
+                and rx > (bad[g] if isinstance(bad[g], float) else -1.0)
+            ):
+                bad[g] = float(rx) if isinstance(rx, (int, float)) else None
+    txt = ", ".join(f"{o}={outcomes[o]}" for o in sorted(outcomes))
+    print(f"  canary: {len(cans)} probes ({txt})", file=out)
+    for g in sorted(bad):
+        rx = bad[g]
+        print(
+            f"    MISMATCH {g}"
+            + (f" rel_x={rx:.1e}" if rx is not None else ""),
+            file=out,
+        )
 
 
 def _print_journeys_footer(run: List[dict], out) -> None:
@@ -587,6 +685,7 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
     _print_spans(run, out, max_spans)
     _print_solves(run, out)
     _print_health_footer(run, out)
+    _print_conformance_footer(run, out)
     _print_warm_footer(run, out)
     _print_journeys_footer(run, out)
     _print_compile_footer(run, out)
