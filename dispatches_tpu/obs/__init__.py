@@ -100,6 +100,12 @@ from .alerts import (  # noqa: F401
     default_fleet_rules,
     rule_from_dict,
 )
+from .capacity import (  # noqa: F401
+    CapacityEstimate,
+    CapacityObservatory,
+    FleetTwin,
+    as_capacity,
+)
 from .exporter import TelemetryExporter, start_exporter  # noqa: F401
 from .memory import device_memory_stats, memory_watermark_bytes  # noqa: F401
 from .metrics import (  # noqa: F401
@@ -257,6 +263,10 @@ __all__ = [
     "rule_from_dict",
     "Signal",
     "ControlSignals",
+    "CapacityEstimate",
+    "CapacityObservatory",
+    "FleetTwin",
+    "as_capacity",
     "sum_gauges",
     "PerfProbe",
     "parse_hlo_module",

@@ -143,7 +143,10 @@ def default_fleet_rules(
     slo_fast_burn: float = 14.4,
 ) -> List[AlertRule]:
     """The rule pack `FleetService` installs under ``timeseries=True``:
-    the five conditions the chaos legs actually induce."""
+    the conditions the chaos legs actually induce, plus the capacity
+    plane's saturation early warning (which only evaluates once
+    ``capacity=True`` publishes ``capacity_headroom_ratio`` — absent
+    series produce no alert instances)."""
     return [
         AlertRule(
             name="shard_down", series="serve_shard_up", kind="threshold",
@@ -182,6 +185,14 @@ def default_fleet_rules(
             severity="page",
             description="requests are being quarantined as poisoned "
             "(crash-looping dispatches hit the max_requeues cap)",
+        ),
+        AlertRule(
+            name="saturation_approach", series="capacity_headroom_ratio",
+            kind="threshold", op="<", bound=0.15, clear_bound=0.30,
+            window=30.0, agg="avg", for_=0.0, severity="warn",
+            description="a shard's measured capacity headroom is nearly "
+            "exhausted (the fleet is approaching its saturation knee; "
+            "scale out before the admission queue starts shedding)",
         ),
     ]
 

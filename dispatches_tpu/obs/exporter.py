@@ -28,6 +28,11 @@ three endpoints an operator actually points things at:
   counts, and worst certificates plus the canary scheduler's per-golden
   last scores. 404 until a callback is attached, so deployments without
   the accuracy plane cost nothing.
+- ``/capacity`` — the attached ``capacity_fn`` (the fleet's
+  `FleetService.capacity_report`): the measured service laws, the
+  fleet twin's validation + saturation knee, the time-to-breach
+  forecast, and the damped ``fleet_desired_shards`` recommendation.
+  404 until a callback is attached.
 
 Design rules, same as the rest of `obs`: stdlib only, off by default
 (nothing starts a server unless a tool passes ``--exporter-port``),
@@ -68,6 +73,7 @@ class TelemetryExporter:
         store: Optional[Any] = None,
         alerts: Optional[Any] = None,
         conformance_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        capacity_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.host = str(host)
         self.port = int(port)
@@ -78,6 +84,7 @@ class TelemetryExporter:
         self.store = store  # obs.timeseries.SeriesStore, serves /query
         self.alerts = alerts  # obs.alerts.AlertManager, serves /alerts
         self.conformance_fn = conformance_fn  # serves /conformance
+        self.capacity_fn = capacity_fn  # serves /capacity
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -163,6 +170,13 @@ class TelemetryExporter:
                         b"no conformance plane attached\n",
                     )
                 return 200, "application/json", _json_bytes(self.conformance_fn())
+            if path == "/capacity":
+                if self.capacity_fn is None:
+                    return (
+                        404, "text/plain; charset=utf-8",
+                        b"no capacity plane attached\n",
+                    )
+                return 200, "application/json", _json_bytes(self.capacity_fn())
             return 404, "text/plain; charset=utf-8", b"not found\n"
         except Exception as e:  # a broken callback must not kill the server
             return (

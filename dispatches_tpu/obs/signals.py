@@ -142,25 +142,51 @@ class _UtilizationSignal(Signal):
     """Summed in-flight lanes over fleet capacity, smoothed. Falls back
     to the instantaneous `sum_gauges` reading while the store is still
     empty (a controller asking one pump cycle after boot should see the
-    truth, not None, when the gauges already exist)."""
+    truth, not None, when the gauges already exist).
+
+    The denominator is *live*: the static construction-time capacity is
+    scaled by the fraction of shards currently up (the latest
+    ``serve_shard_up`` sample per shard series), so a crash window reads
+    as HIGHER utilization — the surviving shards really are closer to
+    saturation — instead of silently undercounting against lanes that
+    no longer exist. While the store is too young to have retained any
+    ``serve_shard_up`` samples (or every shard is down), the static
+    capacity is the fallback."""
 
     def __init__(self, store, capacity, **kw):
         super().__init__(store, "serve_shard_inflight", **kw)
         self.capacity = float(capacity) if capacity else None
 
+    def _live_capacity(self, now: Optional[float]) -> Optional[float]:
+        if not self.capacity:
+            return None
+        t = self.clock() if now is None else float(now)
+        series = self.store.query(
+            "serve_shard_up", None, window=self.window, now=t
+        )
+        series = [s for s in series if s["v"]]
+        if not series:
+            return self.capacity  # store young: static fallback
+        up = sum(s["v"][-1] for s in series)
+        if up <= 0:
+            return self.capacity  # whole fleet down: avoid a 0 denominator
+        return self.capacity * up / len(series)
+
     def value(self, now: Optional[float] = None) -> Optional[float]:
         v = super().value(now)
         if v is None:
             v = self.store._registry().sum_gauges("serve_shard_inflight")
-        if v is None or not self.capacity:
+        cap = self._live_capacity(now)
+        if v is None or not cap:
             return v
-        return v / self.capacity
+        return v / cap
 
     def trend(self, now: Optional[float] = None) -> Optional[float]:
         t = super().trend(now)
-        if t is None or not self.capacity:
+        cap = self._live_capacity(now)
+        if t is None or not cap:
             return t
-        return t / self.capacity
+        return t / cap
 
 
 class ControlSignals:

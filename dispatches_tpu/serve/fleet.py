@@ -156,6 +156,7 @@ class FleetService:
         slo_fn=None,
         conformance=None,
         canary=None,
+        capacity=None,
     ):
         if not shards:
             raise ValueError("a fleet needs at least one shard")
@@ -216,7 +217,8 @@ class FleetService:
         # between two cadence samples (a 0.25 s backoff vs a 1 s tier).
         self.store = store
         self.alerts = None
-        if timeseries and self.store is None:
+        capacity_on = capacity is not None and capacity is not False
+        if (timeseries or capacity_on) and self.store is None:
             from ..obs.timeseries import SeriesStore
 
             self.store = SeriesStore(clock=clock)
@@ -243,6 +245,25 @@ class FleetService:
             # zero-seed so the first poison produces a computable rate
             # (a counter born at 1 has no baseline inside the window)
             self.store._registry().inc("poisoned_requests_total", 0)
+        # capacity observatory (docs/observability.md §13): measured
+        # service laws + the deterministic fleet twin, ticked from
+        # pump() after each fresh store sample. Reads only retained
+        # telemetry, so solve results stay bitwise identical.
+        self.capacity = None
+        if capacity_on:
+            from ..obs.capacity import as_capacity
+
+            self.capacity = as_capacity(
+                capacity,
+                store=self.store,
+                lanes_per_shard=ref.bucket,
+                shards=len(shards),
+                queue_limit=queue_limit,
+                clock=clock,
+                up_shards_fn=lambda: sum(
+                    1 for s in self._slots if s.state == "up"
+                ),
+            )
         self._ts_force = False
         self._lock = threading.RLock()
         self._seq = 0
@@ -386,6 +407,8 @@ class FleetService:
                 self._ts_force = False
                 if sampled and self.alerts is not None:
                     self.alerts.evaluate(t)
+                if sampled and self.capacity is not None:
+                    self.capacity.tick(t)
         return done
 
     def _harvest(self) -> int:
@@ -1021,6 +1044,15 @@ class FleetService:
                 out["canary"] = self.canary.report()
         return out
 
+    def capacity_report(self) -> dict:
+        """The exporter's ``/capacity`` payload: the measured service
+        laws, the twin's validation + knee, the breach forecast, and
+        the damped shard recommendation. Empty when the plane is off."""
+        with self._lock:
+            if self.capacity is None:
+                return {}
+            return self.capacity.report()
+
     def stats(self) -> dict:
         with self._lock:
             out = {
@@ -1064,6 +1096,8 @@ class FleetService:
                 out["timeseries"] = self.store.stats()
             if self.alerts is not None:
                 out["alerts_firing"] = self.alerts.firing()
+            if self.capacity is not None:
+                out["capacity"] = self.capacity.report()
             for status in ("ok", "cached"):
                 for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
                     v = obs_metrics.histogram_quantile(
@@ -1091,6 +1125,7 @@ def make_dense_fleet(
     warm_model: Optional[str] = None,
     conformance=None,
     canary=None,
+    capacity=None,
     **fleet_kw,
 ) -> FleetService:
     """A `FleetService` of `n_shards` dense-LP shard processes, each
@@ -1121,7 +1156,14 @@ def make_dense_fleet(
     ``canary`` (a goldens ``.npz`` path, a golden list, or a
     `serve.canary.CanaryScheduler`) injects certified golden problems
     through the full router->shard path from ``pump()`` on a cadence
-    (docs/observability.md §12, docs/serving.md)."""
+    (docs/observability.md §12, docs/serving.md). ``capacity`` (True /
+    a mapping of `obs.capacity.CapacityObservatory` knobs / an
+    observatory) attaches the capacity plane — measured service laws,
+    the deterministic fleet twin, `fleet_desired_shards`, and the
+    per-shard headroom gauges — ticked from ``pump()`` after each
+    store sample; it implies a `SeriesStore` and, like the rest of the
+    obs planes, is off by default and bitwise-neutral on solve results
+    (docs/observability.md §13)."""
     import os
 
     from ..parallel.mesh import shard_device_env
@@ -1151,5 +1193,6 @@ def make_dense_fleet(
         shards, queue_limit=queue_limit, tenants=tenants, cache=cache,
         clock=clock, reqtrace=reqtrace, spawn=spawn,
         timeseries=timeseries, conformance=conformance, canary=canary,
+        capacity=capacity,
         **fleet_kw,
     )

@@ -93,6 +93,7 @@ class DispatchService:
         reqtrace: bool = False,
         mem_sample_every: int = 32,
         store=None,
+        capacity=None,
     ):
         self.engine = engine
         self.queue = AdmissionQueue(queue_limit)
@@ -110,6 +111,10 @@ class DispatchService:
         # history accrues at the store's raw resolution with zero effect
         # on solve results — the sampler only reads registry floats
         self.store = store
+        # obs.capacity.CapacityObservatory (None = capacity plane off,
+        # the default): tick() runs from pump() after the store sample —
+        # pure reads of retained telemetry, bitwise-neutral on results
+        self.capacity = capacity
         self._pump_count = 0
         self._lock = threading.RLock()
         self._seq = 0
@@ -256,6 +261,8 @@ class DispatchService:
             )
             if self.store is not None:
                 self.store.maybe_sample(self.clock())
+            if self.capacity is not None:
+                self.capacity.tick(self.clock())
         return done
 
     def drain(
@@ -540,6 +547,8 @@ class DispatchService:
                 out["conformance"] = conf.report()
             if self.store is not None:
                 out["timeseries"] = self.store.stats()
+            if self.capacity is not None:
+                out["capacity"] = self.capacity.report()
             for status in ("ok", "cached"):
                 for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
                     v = obs_metrics.histogram_quantile(
@@ -564,6 +573,7 @@ def make_dense_service(
     warm_model=None,
     remedy=None,
     conformance=None,
+    capacity=None,
     **solver_kw,
 ) -> DispatchService:
     """A `DispatchService` over dense `LPData` rows solved by the IPM:
@@ -597,7 +607,14 @@ def make_dense_service(
     default None = unchecked, bitwise-identical) certifies every
     harvested row's KKT conditions at harvest, journals the certificates
     on solve events, and escalates policy failures to the `inaccurate`
-    verdict (docs/observability.md §12)."""
+    verdict (docs/observability.md §12).
+
+    `capacity` (True / a mapping of `obs.capacity.CapacityObservatory`
+    knobs / an observatory; default None = capacity plane off,
+    bitwise-identical) attaches the capacity observatory — measured
+    service laws, the deterministic fleet twin, and the
+    `fleet_desired_shards` / headroom gauges — ticked from `pump()`;
+    implies a `SeriesStore` (docs/observability.md §13)."""
     from ..runtime.adaptive import make_dense_engine
 
     remedy_engine = None
@@ -624,11 +641,20 @@ def make_dense_service(
         engine.perf = PerfProbe(clock=clock)
     cache = ResultCache(cache_size) if cache_size else None
     store = None
-    if timeseries:
+    capacity_on = capacity is not None and capacity is not False
+    if timeseries or capacity_on:
         from ..obs.timeseries import SeriesStore
 
         store = SeriesStore(clock=clock)
+    observatory = None
+    if capacity_on:
+        from ..obs.capacity import as_capacity
+
+        observatory = as_capacity(
+            capacity, store=store, lanes_per_shard=bucket, shards=1,
+            queue_limit=queue_limit, clock=clock,
+        )
     return DispatchService(
         engine, queue_limit=queue_limit, cache=cache, clock=clock,
-        reqtrace=reqtrace, store=store,
+        reqtrace=reqtrace, store=store, capacity=observatory,
     )

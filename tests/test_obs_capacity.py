@@ -1,0 +1,338 @@
+"""Observability pillar 13: the capacity observatory (`obs.capacity`) —
+the measured service laws (Little's law / utilization law over a
+synthetic M/M/c-style fixture with known lambda and mu), the
+deterministic fleet-twin queue replay and its knee prediction, the
+hysteresis-damped recommendation, the exporter's ``/capacity`` route,
+and the serving tier's ``capacity=True`` wiring. Everything runs on
+injectable clocks and private registries except the one deliberately-
+real test: the bitwise-neutrality check at the service entry (pays a
+jax compile, so it stays small)."""
+import json
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.obs.capacity import (
+    CapacityObservatory,
+    FleetTwin,
+    as_capacity,
+)
+from dispatches_tpu.obs.exporter import TelemetryExporter
+from dispatches_tpu.obs.metrics import MetricsRegistry, reset_metrics
+from dispatches_tpu.obs.timeseries import SeriesStore
+from dispatches_tpu.serve import make_dense_service
+from dispatches_tpu.serve.service import LATENCY_BUCKETS
+
+
+def _lp(seed, n=6, m=3, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n))
+    x0 = r.uniform(0.5, 1.5, size=n)
+    return LPData(
+        jnp.asarray(A, dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+class Clk:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# the known-law fixture: lambda = 20 req/s, mean sojourn W = 0.25 s
+# (latency pattern below), queue L_q = 1, busy lanes = 4 across 2
+# shards of 4 lanes each. Exact by construction:
+#   L = L_q + busy = 5 = lambda * W          (Little)
+#   S = busy / X = 0.2 = W - L_q / X         (utilization law)
+_LAT_PATTERN = (0.15, 0.20, 0.25, 0.30, 0.35)  # mean 0.25
+
+
+def _steady_store(
+    seconds=61, lam=20, queue=1.0, shards=2, inflight_per_shard=2.0,
+    lam_ramp=0.0,
+):
+    reg = MetricsRegistry()
+    clk = Clk()
+    store = SeriesStore(reg, tiers=((1.0, 128),), clock=clk)
+    for t in range(seconds):
+        clk.t = float(t)
+        n = int(round(lam + lam_ramp * t))
+        for i in range(n):
+            reg.observe(
+                "serve_latency_seconds", _LAT_PATTERN[i % 5],
+                buckets=LATENCY_BUCKETS, status="ok",
+            )
+            reg.inc("serve_requests_total", status="ok")
+        reg.set_gauge("serve_queue_depth", queue)
+        for s in range(shards):
+            reg.set_gauge("serve_shard_inflight", inflight_per_shard,
+                          shard=str(s))
+            reg.set_gauge("serve_shard_up", 1.0, shard=str(s))
+        store.sample(float(t))
+    return reg, clk, store
+
+
+def _obs(store, clk, **kw):
+    kw.setdefault("lanes_per_shard", 4)
+    kw.setdefault("shards", 2)
+    kw.setdefault("p95_target", 1.0)
+    return CapacityObservatory(store, clock=clk, **kw)
+
+
+# the service distribution matching the fixture: mean 0.2 s
+_SVC_QUANTILES = ((0.0, 0.1), (0.5, 0.2), (0.95, 0.3), (1.0, 0.32))
+
+
+# ---------------------------------------------------------------------
+# the deterministic fleet twin
+# ---------------------------------------------------------------------
+class TestFleetTwin:
+    def test_deterministic_replay(self):
+        tw = FleetTwin(_SVC_QUANTILES, lanes_per_shard=4, seed=3)
+        a = tw.simulate(15.0, 2, requests=1500)
+        b = tw.simulate(15.0, 2, requests=1500)
+        assert a == b
+        # different seed, different draw, same law-scale answers
+        c = FleetTwin(_SVC_QUANTILES, lanes_per_shard=4, seed=4).simulate(
+            15.0, 2, requests=1500
+        )
+        assert c != a
+        assert c["p95_s"] == pytest.approx(a["p95_s"], rel=0.25)
+
+    def test_low_load_sojourn_is_the_service_time(self):
+        # at 10% utilization there is no queueing: predicted p95 sojourn
+        # must sit on the service distribution's p95 knot
+        tw = FleetTwin(_SVC_QUANTILES, lanes_per_shard=4)
+        sim = tw.simulate(4.0, 2, requests=3000)  # util ~0.1
+        assert sim["p95_s"] == pytest.approx(0.3, rel=0.15)
+        assert sim["shed_frac"] == 0.0
+        assert sim["goodput_per_sec"] == pytest.approx(4.0, rel=0.15)
+
+    def test_saturation_caps_goodput(self):
+        # capacity is c/S = 8/0.2 = 40/s; offering 80/s must not deliver
+        # more than capacity and p95 must inflate well past service p95
+        tw = FleetTwin(_SVC_QUANTILES, lanes_per_shard=4, queue_limit=64)
+        sim = tw.simulate(80.0, 2, requests=4000)
+        assert sim["goodput_per_sec"] <= 40.0 * 1.15
+        assert sim["p95_s"] > 0.6
+
+    def test_knee_scales_with_shards(self):
+        tw = FleetTwin(_SVC_QUANTILES, lanes_per_shard=4)
+        k1 = tw.knee(1, p95_limit=1.0)
+        k2 = tw.knee(2, p95_limit=1.0)
+        assert k2["knee_rate_per_sec"] > 1.5 * k1["knee_rate_per_sec"]
+        # analytic bracket for the 2-shard fleet: the knee of an 8-lane
+        # M/G/c with S=0.2 sits near (but under ~1.4x of) c/S = 40/s
+        assert 24.0 <= k2["knee_rate_per_sec"] <= 56.0
+        assert k2["p95_at_knee_s"] <= 1.0
+
+    def test_rejects_malformed_inputs(self):
+        with pytest.raises(ValueError):
+            FleetTwin([(0.5, 0.1)], lanes_per_shard=4)
+        with pytest.raises(ValueError):
+            FleetTwin(_SVC_QUANTILES, lanes_per_shard=0)
+        tw = FleetTwin(_SVC_QUANTILES, lanes_per_shard=4)
+        with pytest.raises(ValueError):
+            tw.simulate(0.0, 2)
+
+
+# ---------------------------------------------------------------------
+# the measured laws over the known-lambda/mu fixture
+# ---------------------------------------------------------------------
+class TestEstimatorLaws:
+    def test_littles_law_residual_under_tolerance(self):
+        reg, clk, store = _steady_store()
+        est = _obs(store, clk).estimate(60.0)
+        assert est.ok
+        assert est.throughput == pytest.approx(20.0, rel=0.1)
+        assert est.latency_mean_s == pytest.approx(0.25, rel=0.05)
+        assert est.littles_residual < 0.1
+        assert est.utilization_residual < 0.15
+
+    def test_service_time_from_utilization_law(self):
+        reg, clk, store = _steady_store()
+        est = _obs(store, clk).estimate(60.0)
+        # S = busy/X = 4/20, independent of the (inflated) sojourn
+        assert est.service_time_s == pytest.approx(0.2, rel=0.1)
+        qs = dict(est.service_quantiles())
+        mean = sum(
+            0.5 * (v0 + v1) * (q1 - q0)
+            for (q0, v0), (q1, v1) in zip(
+                sorted(qs.items()), sorted(qs.items())[1:]
+            )
+        )
+        assert mean == pytest.approx(est.service_time_s, rel=0.01)
+
+    def test_per_shard_headroom(self):
+        reg, clk, store = _steady_store()
+        est = _obs(store, clk).estimate(60.0)
+        assert set(est.per_shard) == {"0", "1"}
+        for row in est.per_shard.values():
+            assert row["utilization"] == pytest.approx(0.5, abs=0.05)
+            assert row["headroom_ratio"] == pytest.approx(0.5, abs=0.05)
+
+    def test_broken_telemetry_is_observable(self):
+        # halve the inflight gauges without touching the counters — the
+        # books no longer balance and the residuals must say so
+        reg, clk, store = _steady_store(inflight_per_shard=0.5)
+        est = _obs(store, clk).estimate(60.0)
+        assert est.ok
+        assert (
+            est.littles_residual > 0.3 or est.utilization_residual > 0.3
+        )
+
+    def test_young_store_holds(self):
+        reg = MetricsRegistry()
+        clk = Clk()
+        store = SeriesStore(reg, tiers=((1.0, 16),), clock=clk)
+        est = _obs(store, clk).estimate(0.0)
+        assert not est.ok
+        # tick() still runs without publishing garbage
+        obs = _obs(store, clk)
+        assert obs.tick(0.0, force=True)
+        flat = {k for k in reg.snapshot()["gauges"]}
+        assert not any(k.startswith("capacity_") for k in flat)
+
+
+# ---------------------------------------------------------------------
+# the pump-driven observatory: gauges, validation, forecast, damping
+# ---------------------------------------------------------------------
+class TestObservatoryTick:
+    def test_gauges_and_twin_validation(self):
+        reg, clk, store = _steady_store()
+        obs = _obs(store, clk)
+        assert obs.tick(60.0, force=True)
+        gauges = reg.snapshot()["gauges"]
+        assert "capacity_littles_law_residual" in gauges
+        assert "capacity_utilization_law_residual" in gauges
+        assert 'capacity_headroom_ratio{shard="0"}' in gauges
+        assert "capacity_knee_rate_per_sec" in gauges
+        assert "fleet_desired_shards" in gauges
+        # the twin reproduces the fleet's own observed p95 at the
+        # current operating point within the documented tolerance
+        assert gauges["capacity_model_error_ratio"] < 0.75
+        # fixture is a 2-shard fleet at half load: 1-2 shards suffice
+        assert 1 <= gauges["fleet_desired_shards"] <= 2
+        # knee of the 8-lane fixture fleet brackets c/S = 40/s
+        assert 24.0 <= gauges["capacity_knee_rate_per_sec"] <= 56.0
+        rep = obs.report()
+        assert rep["twin"]["ready"]
+        assert rep["estimate"]["ok"]
+        json.dumps(rep)  # must be JSON-safe for /capacity
+
+    def test_eval_rate_limit(self):
+        reg, clk, store = _steady_store()
+        obs = _obs(store, clk, eval_every=5.0)
+        assert obs.tick(60.0)
+        assert not obs.tick(61.0)  # inside eval_every
+        assert obs.tick(66.0)
+
+    def test_rising_arrivals_forecast_finite_breach(self):
+        reg, clk, store = _steady_store(seconds=121, lam=5, lam_ramp=0.25)
+        obs = _obs(store, clk)
+        obs.tick(120.0, force=True)
+        ttb = obs.report()["forecast"]["time_to_breach_s"]
+        assert ttb is not None and ttb >= 0.0
+
+    def test_steady_arrivals_forecast_no_breach(self):
+        reg, clk, store = _steady_store()
+        obs = _obs(store, clk)
+        obs.tick(60.0, force=True)
+        assert obs.report()["forecast"]["time_to_breach_s"] is None
+        gauges = reg.snapshot()["gauges"]
+        assert "capacity_time_to_breach_seconds" not in gauges
+
+    def test_hysteresis_damping(self):
+        reg, clk, store = _steady_store()
+        obs = _obs(store, clk, up_hold=0.0, down_hold=60.0)
+        obs._damp(2, 0.0)
+        assert obs._desired == 2  # first recommendation applies directly
+        obs._damp(3, 1.0)
+        assert obs._desired == 3  # scale-up is immediate (up_hold=0)
+        obs._damp(1, 2.0)
+        assert obs._desired == 3  # scale-down held back
+        obs._damp(1, 30.0)
+        assert obs._desired == 3  # still inside down_hold
+        obs._damp(2, 40.0)
+        obs._damp(2, 50.0)
+        assert obs._desired == 3  # changing target resets the hold
+        obs._damp(1, 55.0)
+        obs._damp(1, 120.0)
+        assert obs._desired == 1  # held long enough: scale down lands
+
+    def test_as_capacity_coercion(self):
+        reg, clk, store = _steady_store(seconds=3)
+        obs = _obs(store, clk)
+        assert as_capacity(obs, store=store) is obs
+        built = as_capacity(
+            {"p95_target": 0.1}, store=store, lanes_per_shard=4, shards=2,
+            clock=clk,
+        )
+        assert built.p95_target == 0.1
+        with pytest.raises(TypeError):
+            as_capacity(42, store=store, lanes_per_shard=4, shards=2)
+
+
+# ---------------------------------------------------------------------
+# exporter route
+# ---------------------------------------------------------------------
+class TestExporterCapacityRoute:
+    def test_unattached_404(self):
+        status, _, body = TelemetryExporter().handle_path("/capacity")
+        assert status == 404
+        assert b"no capacity plane" in body
+
+    def test_attached_payload(self):
+        reg, clk, store = _steady_store()
+        obs = _obs(store, clk)
+        obs.tick(60.0, force=True)
+        exp = TelemetryExporter(registry=reg, capacity_fn=obs.report)
+        status, ctype, body = exp.handle_path("/capacity")
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["twin"]["ready"]
+        assert payload["recommendation"]["desired_shards"] >= 1
+
+
+# ---------------------------------------------------------------------
+# the one deliberately-real test (pays a jax compile)
+# ---------------------------------------------------------------------
+class TestCapacityNeutrality:
+    def test_service_results_bitwise_identical_with_plane_on(self):
+        reset_metrics()
+        lps = [_lp(s) for s in range(3)]
+        plain = make_dense_service(2, chunk_iters=4, cache_size=None,
+                                   max_iter=40)
+        tickets = [plain.submit(lp) for lp in lps]
+        plain.drain()
+        ref = [t.result(0) for t in tickets]
+
+        svc = make_dense_service(2, chunk_iters=4, cache_size=None,
+                                 max_iter=40, capacity=True)
+        assert svc.capacity is not None and svc.store is not None
+        tickets = [svc.submit(lp) for lp in lps]
+        svc.drain()
+        got = [t.result(0) for t in tickets]
+        for g, r in zip(got, ref):
+            assert g.verdict == r.verdict
+            assert g.iterations == r.iterations
+            for a, b in zip(g.solution, r.solution):
+                assert _biteq(a, b)
+        # the plane was live (store sampled; report answers)
+        assert svc.store.stats()["samples"] >= 1
+        assert "config" in svc.stats()["capacity"]

@@ -541,8 +541,13 @@ class TestAlertManager:
         by_name = {r.name: r for r in rules}
         assert set(by_name) == {
             "shard_down", "shard_pong_wedge", "queue_saturation",
-            "slo_fast_burn", "poison_rate",
+            "slo_fast_burn", "poison_rate", "saturation_approach",
         }
+        assert by_name["saturation_approach"].op == "<"
+        assert (
+            by_name["saturation_approach"].clear_bound
+            > by_name["saturation_approach"].bound
+        )
         assert by_name["queue_saturation"].bound == 80.0
         assert by_name["shard_pong_wedge"].bound == pytest.approx(1.6)
         assert by_name["poison_rate"].kind == "rate"
@@ -604,6 +609,42 @@ class TestControlSignals:
         # without capacity the signal reads absolute lanes
         assert ControlSignals(store).shard_inflight_utilization.value(
             3.0) == pytest.approx(4.0)
+
+    def test_utilization_capacity_follows_shard_up(self):
+        # a crash window must read as HIGHER utilization: the static
+        # capacity denominator is scaled by the live up-shard fraction,
+        # so 2 busy lanes on the 4 surviving lanes of a half-down
+        # 2-shard fleet is 0.5, not 2/8 = 0.25
+        reg, clk, store = _store(tiers=((1.0, 128),))
+        cs = ControlSignals(store, capacity=8.0)
+
+        def _sample(t, both_up):
+            clk.t = float(t)
+            reg.set_gauge("serve_shard_up", 1.0, shard="0")
+            reg.set_gauge("serve_shard_up", 1.0 if both_up else 0.0,
+                          shard="1")
+            reg.set_gauge("serve_shard_inflight", 2.0, shard="0")
+            reg.set_gauge("serve_shard_inflight", 2.0 if both_up else 0.0,
+                          shard="1")
+            store.sample(float(t))
+
+        for t in range(10):
+            _sample(t, both_up=True)
+        # steady half-load while both shards are up
+        assert cs.shard_inflight_utilization.value(9.0) == pytest.approx(
+            0.5, abs=0.05
+        )
+        for t in range(10, 20):
+            _sample(t, both_up=False)
+        # shard 1 down: 2 busy lanes / 4 live lanes, NOT 2/8 — and the
+        # EWMA tail of the pre-crash inflight keeps it strictly above
+        assert cs.shard_inflight_utilization.value(19.0) >= 0.45
+        # whole fleet down falls back to the static denominator rather
+        # than dividing by zero
+        clk.t = 20.0
+        reg.set_gauge("serve_shard_up", 0.0, shard="0")
+        store.sample(20.0)
+        assert cs.shard_inflight_utilization.value(20.0) is not None
 
 
 # ---------------------------------------------------------------------

@@ -30,6 +30,15 @@ glyph (``✓`` passing / ``✗`` MISMATCH / ``?`` inconclusive), and — in
 live mode with a store attached — sparklines of the retained
 ``solve_residual_*_p95`` tracks. Plane-off fleets show no panel.
 
+When the fleet runs the capacity observatory (docs/observability.md
+§13, ``make_dense_fleet(..., capacity=True)``), a capacity panel appears
+in live mode from the ``/capacity`` report: per-shard headroom bars
+(``capacity_headroom_ratio``), the hysteresis-damped
+``fleet_desired_shards`` recommendation against the shards actually up
+(flagged ``<< SCALE UP/DOWN`` on divergence), the fleet twin's knee rate
+and model-validation error, and a time-to-SLO-breach countdown when the
+forecast is finite. Observatory-off fleets show no panel.
+
 Stdlib-only on purpose (same contract as journal_diff/trace_timeline):
 pointing this at a production fleet must not import jax. The series
 parser and histogram quantile mirror `obs.metrics` exactly —
@@ -321,6 +330,63 @@ def conformance_lines(snap: Dict[str, Any]) -> List[str]:
     return ["conformance"] + lines if lines else []
 
 
+def _bar(frac: float, width: int = 20) -> str:
+    frac = min(max(float(frac), 0.0), 1.0)
+    n = int(round(frac * width))
+    return "█" * n + "·" * (width - n)
+
+
+def _countdown(seconds: float) -> str:
+    s = max(0.0, float(seconds))
+    if s <= 0.0:
+        return "NOW (at or past the knee)"
+    if s < 120.0:
+        return f"{s:.0f}s"
+    if s < 7200.0:
+        return f"{s / 60.0:.1f}m"
+    return f"{s / 3600.0:.1f}h"
+
+
+def capacity_lines(cap: Optional[Dict[str, Any]]) -> List[str]:
+    """The capacity panel (docs/observability.md §13) from a
+    ``/capacity`` report: per-shard headroom bars, the hysteresis-damped
+    ``fleet_desired_shards`` recommendation against what is actually
+    up, the twin's knee + validation error, and a time-to-breach
+    countdown when the forecast is finite. Empty (no panel) when the
+    plane is off or the estimator window is not ok yet."""
+    if not cap:
+        return []
+    est = cap.get("estimate") or {}
+    if not est.get("ok"):
+        return []
+    lines = ["capacity"]
+    for shard, row in sorted((est.get("per_shard") or {}).items()):
+        h = row.get("headroom_ratio")
+        if h is None:
+            continue
+        lines.append(
+            f"  shard {shard:<4} headroom [{_bar(h)}] {h * 100.0:3.0f}%"
+        )
+    rec = cap.get("recommendation") or {}
+    twin = cap.get("twin") or {}
+    knee = twin.get("knee") or {}
+    desired = rec.get("desired_shards")
+    actual = rec.get("actual_up_shards")
+    flag = ""
+    if desired is not None and actual is not None and desired != actual:
+        flag = "  << SCALE" + (" UP" if desired > actual else " DOWN")
+    bits = [f"desired {_fmt(desired, nd=0)} vs up {_fmt(actual, nd=0)}{flag}"]
+    if knee.get("knee_rate_per_sec") is not None:
+        bits.append(f"knee {knee['knee_rate_per_sec']:.1f}/s")
+    if twin.get("model_error_ratio") is not None:
+        bits.append(f"model err {twin['model_error_ratio']:.2f}")
+    lines.append("  " + "  ".join(bits))
+    ttb = (cap.get("forecast") or {}).get("time_to_breach_s")
+    if ttb is not None:
+        lines.append(f"  time-to-breach {_countdown(ttb)}")
+    return lines
+
+
 def alert_lines(alerts: Optional[Dict[str, Any]]) -> List[str]:
     """The firing-alerts panel from an ``/alerts`` report: one row per
     firing instance, plus a one-line OK when the pack is quiet."""
@@ -351,6 +417,7 @@ def render(
     dt: Optional[float] = None,
     queries: Optional[Dict[str, Optional[Dict[str, Any]]]] = None,
     alerts: Optional[Dict[str, Any]] = None,
+    capacity: Optional[Dict[str, Any]] = None,
 ) -> str:
     rows = fleet_rows(snap, health, prev, dt)
     n_down = sum(1 for r in rows if not r["up"])
@@ -404,6 +471,7 @@ def render(
         if sl:
             lines.append("history (5m)")
             lines.extend(sl)
+    lines.extend(capacity_lines(capacity))
     lines.extend(alert_lines(alerts))
     return "\n".join(lines)
 
@@ -457,6 +525,11 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
         alerts = _get_json(url + "/alerts")
         if alerts and alerts.get("error"):
             alerts = None
+        # /capacity 404s (plain-text body) when no observatory is
+        # attached; _get_json returns None and the panel vanishes
+        cap = _get_json(url + "/capacity")
+        if cap and cap.get("error"):
+            cap = None
         now = time.monotonic()
         dt = (now - prev_t) if prev_t is not None else None
         if as_json:
@@ -466,9 +539,15 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
                 "health": health,
                 "worst_burn_rate": (slo or {}).get("worst_burn_rate"),
                 "alerts_firing": (alerts or {}).get("firing"),
+                "capacity": {
+                    "desired_shards": ((cap or {}).get("recommendation")
+                                       or {}).get("desired_shards"),
+                    "time_to_breach_s": ((cap or {}).get("forecast")
+                                         or {}).get("time_to_breach_s"),
+                } if cap else None,
             }, default=str))
         else:
-            out = render(snap, health, slo, prev, dt, queries, alerts)
+            out = render(snap, health, slo, prev, dt, queries, alerts, cap)
             if not once:
                 print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
             print(out, flush=True)
@@ -690,6 +769,50 @@ def self_check() -> int:
     check(
         "render appends conformance panel",
         "conformance" in render(csnap) and "conformance" not in render(snap),
+    )
+
+    # capacity panel: headroom bars, recommendation, countdown; no panel
+    # when the plane is off or the estimator window is not ok yet
+    cap_report = {
+        "estimate": {
+            "ok": True,
+            "per_shard": {
+                "0": {"headroom_ratio": 0.25},
+                "1": {"headroom_ratio": 0.80},
+            },
+        },
+        "twin": {"model_error_ratio": 0.12,
+                 "knee": {"knee_rate_per_sec": 9.5}},
+        "forecast": {"time_to_breach_s": 272.0},
+        "recommendation": {"desired_shards": 3, "actual_up_shards": 2},
+    }
+    kl = capacity_lines(cap_report)
+    check(
+        "capacity panel: per-shard headroom bars",
+        any("shard 0" in x and "25%" in x and "█" in x for x in kl)
+        and any("shard 1" in x and "80%" in x for x in kl),
+        str(kl),
+    )
+    check(
+        "capacity panel: desired vs up flags scale-up, knee, model error",
+        any("desired 3 vs up 2" in x and "SCALE UP" in x
+            and "knee 9.5/s" in x and "model err 0.12" in x for x in kl),
+        str(kl),
+    )
+    check(
+        "capacity panel: finite forecast renders a countdown",
+        any("time-to-breach 4.5m" in x for x in kl),
+        str(kl),
+    )
+    check(
+        "capacity panel absent when plane off or estimator not ok",
+        capacity_lines(None) == []
+        and capacity_lines({"estimate": {"ok": False}}) == [],
+    )
+    check(
+        "render appends capacity panel only when a report is passed",
+        "capacity" in render(snap, capacity=cap_report)
+        and "capacity" not in render(snap),
     )
 
     # qps from a counter delta between two polls

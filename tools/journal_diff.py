@@ -73,6 +73,16 @@ _HIGHER_IS_BETTER = (
     # residual creeping up or a mismatch appearing is an accuracy
     # regression even when every latency held)
     "canary_pass", 'outcome="pass"',
+    # capacity observatory (obs/capacity.py): headroom shrinking, the
+    # twin's knee rate dropping, or the time-to-breach runway collapsing
+    # are all saturation approaching — the good direction is up. The law
+    # residuals and capacity_model_error_ratio fall through to
+    # lower-is-better via the "residual"/"error_ratio" early rule in
+    # lower_is_better() (capacity_UTILIZATION_law_residual would
+    # otherwise match the "utilization" throughput pattern above), and
+    # fleet_desired_shards falls through too: the same workload needing
+    # more shards is an efficiency regression.
+    "headroom", "knee_rate", "time_to_breach",
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
@@ -135,11 +145,35 @@ _ZERO_SEEDED = (
     "solve_inaccurate_total", "solve_conformance_total",
     "canary_mismatch_total", "canary_pass_total",
     "canary_inconclusive_total",
+    # capacity observatory (obs/capacity.py): DELIBERATELY seeded even
+    # though the gauges are always published while the plane is on — an
+    # autoscale signal must not silently enter the comparison surface.
+    # Switching the observatory on against an observatory-off baseline
+    # surfaces the law residuals, the model-validation error, and the
+    # shard recommendation as appearing-from-zero rows for review (or a
+    # `--threshold capacity_=...` override); once both sides carry the
+    # series the gate tracks genuine drift. Headroom and the knee rate
+    # seed too but, as higher-is-better, only gate on a same-workload
+    # DROP (saturation approaching). capacity_time_to_breach_seconds is
+    # deliberately NOT here: it is only published while a breach is
+    # actually forecast, so it must stay uncompared when one run never
+    # approached its knee (seeding would read a recovered run's absent
+    # countdown as the runway collapsing to zero).
+    "capacity_littles_law_residual", "capacity_utilization_law_residual",
+    "capacity_model_error_ratio", "capacity_headroom_ratio",
+    "capacity_knee_rate_per_sec", "fleet_desired_shards",
 )
 
 
 def lower_is_better(metric: str) -> bool:
     m = metric.lower()
+    # conservation-law residuals and model-validation error ratios are
+    # always lower-is-better, even when the metric NAME embeds a
+    # higher-is-better substring (capacity_utilization_law_residual
+    # contains "utilization"; solve_residual_* match nothing and land
+    # here too, unchanged)
+    if "residual" in m or "error_ratio" in m:
+        return True
     return not any(pat in m for pat in _HIGHER_IS_BETTER)
 
 
@@ -338,9 +372,14 @@ def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
                 for series, v in (mets.get("gauges") or {}).items():
                     # alerts_firing at close == the run ended degraded;
                     # retained quantile tracks (<hist>_p95{...}) give the
-                    # /query-derived latency surface a comparable row
+                    # /query-derived latency surface a comparable row;
+                    # the capacity observatory's close gauges (law
+                    # residuals, headroom, knee, model error, the shard
+                    # recommendation) are the validated-autoscale surface
                     if _is_num(v) and (
                         series.startswith("alerts_firing")
+                        or series.startswith("capacity_")
+                        or series.startswith("fleet_desired_shards")
                         or "_p9" in series or "_p50" in series
                     ):
                         out[f"metric/{series}"] = float(v)
@@ -1010,6 +1049,71 @@ def self_check(out=sys.stdout) -> int:
         "failed certificates appearing vs plane-off baseline fail "
         "(non-pass outcomes are zero-seeded lower-is-better)",
         True, any(r["regression"] for r in rows)))
+
+    # capacity observatory (obs/capacity.py): headroom / knee /
+    # time-to-breach gate higher-is-better (saturation approaching is
+    # the bad direction), law residuals + model error gate
+    # lower-is-better despite the "utilization" substring, and the
+    # shard recommendation gates on the same workload needing MORE
+    # shards
+    kbase = {
+        'metric/capacity_headroom_ratio{shard="0"}': 0.6,
+        "metric/capacity_knee_rate_per_sec": 9.0,
+        "metric/capacity_littles_law_residual": 0.05,
+        "metric/capacity_utilization_law_residual": 0.05,
+        "metric/capacity_model_error_ratio": 0.10,
+        "metric/fleet_desired_shards": 2.0,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def krun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(kbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    krun("identical capacity metrics pass", dict(kbase), False)
+    krun("headroom collapsing >10% fails (higher is better)",
+         {**kbase, 'metric/capacity_headroom_ratio{shard="0"}': 0.2}, True)
+    krun("headroom growing passes",
+         {**kbase, 'metric/capacity_headroom_ratio{shard="0"}': 0.9}, False)
+    krun("knee rate dropping >10% fails (fleet capacity shrank)",
+         {**kbase, "metric/capacity_knee_rate_per_sec": 6.0}, True)
+    krun("utilization-law residual regression fails (lower is better "
+         'despite the "utilization" substring)',
+         {**kbase, "metric/capacity_utilization_law_residual": 0.5}, True)
+    krun("model-validation error tripling fails (twin stopped predicting)",
+         {**kbase, "metric/capacity_model_error_ratio": 0.4}, True)
+    krun("same workload wanting more shards fails (lower is better)",
+         {**kbase, "metric/fleet_desired_shards": 3.0}, True)
+    krun("recommendation scaling in passes",
+         {**kbase, "metric/fleet_desired_shards": 1.0}, False)
+    rows = compare(
+        {**kbase, "metric/capacity_time_to_breach_seconds": 600.0},
+        {**kbase, "metric/capacity_time_to_breach_seconds": 60.0},
+    )
+    checks.append(("time-to-breach runway collapsing fails "
+                   "(higher is better)",
+                   True, any(r["regression"] for r in rows)))
+    rows = compare(kbase,
+                   {**kbase, "metric/capacity_time_to_breach_seconds": 600.0})
+    checks.append(("countdown appearing when baseline never saturated "
+                   "passes (not zero-seeded: intermittent by design)",
+                   False, any(r["regression"] for r in rows)))
+    cleank = {"serve/loadgen/goodput_rps": 120.0}
+    rows = compare(cleank, kbase)
+    checks.append((
+        "observatory-on run vs observatory-off baseline fails "
+        "(validation residuals + recommendation are zero-seeded so an "
+        "autoscale signal never enters the surface silently)",
+        True, any(r["regression"] for r in rows)))
+    rows = compare(cleank, {
+        **cleank,
+        'metric/capacity_headroom_ratio{shard="0"}': 0.6,
+        "metric/capacity_knee_rate_per_sec": 9.0,
+    })
+    checks.append((
+        "headroom + knee alone appearing vs clean baseline pass "
+        "(higher-is-better never gates on growth)",
+        False, any(r["regression"] for r in rows)))
 
     ok = True
     for name, want, got in checks:

@@ -11,7 +11,15 @@ continuous-batching win.
     python tools/loadgen.py --requests 400 --rate 200 --bucket 8
     python tools/loadgen.py --baseline serial --requests 400 --rate 200
     python tools/loadgen.py --shards 2 --kill-shard   # fleet chaos run
+    python tools/loadgen.py --ramp 20:200:6 --capacity  # saturation sweep
     python tools/loadgen.py --self-check          # CI smoke (CPU)
+
+`--ramp LO:HI:STEPS` sweeps the offered rate in equal steps over ONE
+service instance, emitting per-step rate/goodput/p95 rows — the
+measured saturation curve `tools/capacity_plan.py --self-check` gates
+the capacity twin's knee prediction against. `--capacity` attaches the
+capacity observatory (`dispatches_tpu/obs/capacity.py`) so each row
+also carries its desired-shards / knee / model-error snapshot.
 
 `--shards N` serves the same open-loop schedule with the sharded fleet
 (`dispatches_tpu.serve.make_dense_fleet`: N crash-domain child
@@ -346,6 +354,165 @@ def run_serial(
         "wall_s": wall,
         "goodput_rps": ok / wall if wall > 0 else 0.0,
         **_percentiles(lat),
+    }
+
+
+def _capacity_snapshot(svc):
+    """The capacity observatory's full report, or None when the plane is
+    off. Fleet access goes through `capacity_report()` (lock-holding);
+    the in-process service exposes the observatory directly."""
+    fn = getattr(svc, "capacity_report", None)
+    if fn is not None:
+        return fn() or None
+    cap = getattr(svc, "capacity", None)
+    return cap.report() if cap is not None else None
+
+
+def run_ramp(
+    lo: float,
+    hi: float,
+    steps: int,
+    requests_per_step: int = 60,
+    bucket: int = 8,
+    chunk_iters: int = 8,
+    max_iter: int = 60,
+    queue_limit: int = 256,
+    dup_frac: float = 0.25,
+    seed: int = 0,
+    shards: int = 0,
+    capacity=False,
+    lp_n: int = 8,
+    lp_m: int = 4,
+    deadline_s=None,
+    out=None,
+) -> dict:
+    """Stepped open-loop rate ramp: LO..HI req/s across `steps` equal
+    steps, ONE service (or fleet) across the whole ramp so retained
+    telemetry — and the capacity observatory reading it, when
+    ``capacity=True`` — spans every operating point. Each step drives
+    `requests_per_step` Poisson arrivals at its rate and reports
+    offered rate / goodput / p50 / p95 / shed for that step alone; the
+    saturation knee is wherever goodput stops tracking the offered rate
+    (`tools/capacity_plan.py` turns these rows into a measured-knee
+    gate against the fleet twin's prediction). With ``capacity=True``
+    each row also carries the observatory's compact state (desired
+    shards, knee, model error) after a forced tick, and the report's
+    top-level ``capacity`` key holds the final full report — including
+    ``service_quantiles``, enough to rebuild the twin offline."""
+    _enable_x64()
+    from dispatches_tpu.serve import make_dense_fleet, make_dense_service
+
+    if steps < 1 or lo <= 0 or hi < lo:
+        raise ValueError("ramp wants 0 < LO <= HI and STEPS >= 1")
+    rates = [
+        lo + (hi - lo) * i / max(steps - 1, 1) for i in range(steps)
+    ]
+    if shards > 0:
+        svc = make_dense_fleet(
+            shards, bucket, chunk_iters=chunk_iters,
+            queue_limit=queue_limit, solver_kw={"max_iter": max_iter},
+            capacity=capacity,
+        )
+    else:
+        svc = make_dense_service(
+            bucket, chunk_iters=chunk_iters, max_iter=max_iter,
+            queue_limit=queue_limit, capacity=capacity,
+        )
+    # warm the executables outside the measurement window (deploy-time
+    # compile): one distinct-fingerprint problem per shard so EVERY
+    # crash domain compiles before step 0 (the least-loaded router
+    # spreads them), not just whichever shard won the first dispatch
+    for w in range(max(1, shards)):
+        svc.submit(make_problem(10**6 + w, n=lp_n, m=lp_m),
+                   priority="batch")
+    svc.drain()
+    svc.start()
+    rows = []
+    try:
+        for k, r in enumerate(rates):
+            n = requests_per_step
+            # offset the seed pool per step: dup_frac repeats stay
+            # within a step, but steps never replay an earlier step's
+            # fingerprints (a ramp of cache hits measures the cache,
+            # not the service)
+            seeds = [
+                s + 100_000 * k
+                for s in problem_seeds(n, dup_frac, seed + 101 * k)
+            ]
+            problems = {
+                s: make_problem(s, n=lp_n, m=lp_m) for s in set(seeds)
+            }
+            sched = arrival_schedule(n, r, seed + 101 * k)
+            t0 = time.monotonic()
+            tickets = []
+            for i, (s, due) in enumerate(zip(seeds, sched)):
+                lag = t0 + due - time.monotonic()
+                if lag > 0:
+                    time.sleep(lag)
+                tickets.append(svc.submit(
+                    problems[s], request_id=f"ramp{k}_{i}",
+                    timeout=deadline_s,
+                ))
+            results = [t.result(timeout=240.0) for t in tickets]
+            wall = time.monotonic() - t0
+            ok = [x for x in results if x.ok]
+            lat = [x.latency for x in results if x.latency is not None]
+            row = {
+                "step": k,
+                "rate_rps": r,
+                "offered": n,
+                "ok": len(ok),
+                "shed": sum(x.verdict == "shed" for x in results),
+                "wall_s": wall,
+                "goodput_rps": len(ok) / wall if wall > 0 else 0.0,
+                **_percentiles(lat),
+            }
+            cap = getattr(svc, "capacity", None)
+            if cap is not None:
+                # force a cycle so the row reflects THIS step's window,
+                # not whenever the pump's rate-limit last let one run
+                cap.tick(force=True)
+                rep = _capacity_snapshot(svc) or {}
+                knee = (rep.get("twin") or {}).get("knee") or {}
+                row["capacity"] = {
+                    "desired_shards": (
+                        rep.get("recommendation") or {}
+                    ).get("desired_shards"),
+                    "knee_rate_per_sec": knee.get("knee_rate_per_sec"),
+                    "model_error_ratio": (
+                        rep.get("twin") or {}
+                    ).get("model_error_ratio"),
+                    "littles_residual": (
+                        (rep.get("estimate") or {}).get("littles_residual")
+                    ),
+                    "time_to_breach_s": (
+                        rep.get("forecast") or {}
+                    ).get("time_to_breach_s"),
+                }
+            rows.append(row)
+            if out is not None:
+                print(
+                    f"ramp step {k}: rate={r:.1f}/s "
+                    f"goodput={row['goodput_rps']:.1f}/s "
+                    f"p95={(row['p95_s'] or 0.0) * 1e3:.0f}ms "
+                    f"shed={row['shed']}", file=out,
+                )
+        final_capacity = _capacity_snapshot(svc)
+    finally:
+        if shards > 0:
+            svc.close()
+        else:
+            svc.stop()
+    return {
+        "mode": "ramp",
+        "lo_rps": lo,
+        "hi_rps": hi,
+        "steps": steps,
+        "requests_per_step": requests_per_step,
+        "bucket": bucket,
+        "shards": shards,
+        "rows": rows,
+        "capacity": final_capacity,
     }
 
 
@@ -1207,6 +1374,16 @@ def main(argv=None) -> int:
                     help="serve /metrics /healthz /slo /snapshot on this "
                     "port for the duration of the run (0 = ephemeral; "
                     "implies --telemetry when --shards > 0)")
+    ap.add_argument("--ramp", default=None, metavar="LO:HI:STEPS",
+                    help="stepped open-loop rate ramp instead of a single "
+                    "rate: LO..HI req/s across STEPS equal steps, one "
+                    "service across the whole ramp, per-step "
+                    "rate/goodput/p95 rows (--requests = requests per "
+                    "step)")
+    ap.add_argument("--capacity", action="store_true",
+                    help="attach the capacity observatory "
+                    "(obs/capacity.py) to the ramp service; rows gain "
+                    "desired-shards/knee/model-error snapshots")
     ap.add_argument("--warm-model", default=None,
                     help="learned warm-start artifact "
                     "(tools/train_warmstart.py) seeding cold dispatches; "
@@ -1233,6 +1410,24 @@ def main(argv=None) -> int:
     if args.kill_shard and args.shards < 2:
         ap.error("--kill-shard needs --shards >= 2 (a 1-shard fleet "
                  "killed mid-run has nowhere to requeue)")
+
+    if args.ramp is not None:
+        try:
+            lo_s, hi_s, steps_s = args.ramp.split(":")
+            lo, hi, steps = float(lo_s), float(hi_s), int(steps_s)
+        except ValueError:
+            ap.error("--ramp wants LO:HI:STEPS (e.g. 20:200:6)")
+        report = run_ramp(
+            lo, hi, steps, requests_per_step=args.requests,
+            bucket=args.bucket, chunk_iters=args.chunk_iters,
+            max_iter=args.max_iter, queue_limit=args.queue_limit,
+            dup_frac=args.dup_frac, seed=args.seed, shards=args.shards,
+            capacity=args.capacity, deadline_s=args.deadline,
+            out=None if args.json else sys.stderr,
+        )
+        print(json.dumps(report, indent=None if args.json else 2,
+                         default=str))
+        return RC_OK
 
     if args.baseline == "serial":
         report = run_serial(
