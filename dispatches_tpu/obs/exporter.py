@@ -33,6 +33,11 @@ three endpoints an operator actually points things at:
   fleet twin's validation + saturation knee, the time-to-breach
   forecast, and the damped ``fleet_desired_shards`` recommendation.
   404 until a callback is attached.
+- ``/lanes`` — the attached ``lanes_fn`` (the fleet's
+  `FleetService.lane_report` / `DispatchService.lane_report`): the lane
+  observatory's decision/probe counters, per-family (family, lane)
+  scoreboards with win ratios and wall percentiles, and the current
+  damped ``route_advice``. 404 until a callback is attached.
 
 Design rules, same as the rest of `obs`: stdlib only, off by default
 (nothing starts a server unless a tool passes ``--exporter-port``),
@@ -74,6 +79,7 @@ class TelemetryExporter:
         alerts: Optional[Any] = None,
         conformance_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         capacity_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        lanes_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.host = str(host)
         self.port = int(port)
@@ -85,6 +91,7 @@ class TelemetryExporter:
         self.alerts = alerts  # obs.alerts.AlertManager, serves /alerts
         self.conformance_fn = conformance_fn  # serves /conformance
         self.capacity_fn = capacity_fn  # serves /capacity
+        self.lanes_fn = lanes_fn  # serves /lanes
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -177,6 +184,13 @@ class TelemetryExporter:
                         b"no capacity plane attached\n",
                     )
                 return 200, "application/json", _json_bytes(self.capacity_fn())
+            if path == "/lanes":
+                if self.lanes_fn is None:
+                    return (
+                        404, "text/plain; charset=utf-8",
+                        b"no lane observatory attached\n",
+                    )
+                return 200, "application/json", _json_bytes(self.lanes_fn())
             return 404, "text/plain; charset=utf-8", b"not found\n"
         except Exception as e:  # a broken callback must not kill the server
             return (

@@ -54,7 +54,19 @@ from . import retrace as _retrace
 #     observed by a PerfProbe — compile key/entry/bucket, elapsed
 #     seconds, cache outcome, persistent-cache config, and optional
 #     executable/code sizes + model FLOPs from AOT cost capture.
-_SCHEMA_VERSION = 4
+# v5: solve records gain optional "conformance" (KKT certificate fields
+#     + outcome from obs.conformance), "remediation" (runtime.remedy
+#     ladder outcome), and "health" attrs; "canary_*" events
+#     (serve.canary golden rounds). Additive-only; readers of v4
+#     journals are unaffected. (Retroactively documented: these records
+#     shipped while the constant still said 4.)
+# v6: "lane_decision" records (obs.lanes): one per routed solve —
+#     chosen lane, family fingerprint, feature-vector digest, wall,
+#     iterations, verdict; "lane_probe" records: one per shadow-lane
+#     re-solve — both lanes' measured walls/iterations, regret, outcome,
+#     cache-defeating probe fingerprint. Solve records gain an optional
+#     "lane" attr.
+_SCHEMA_VERSION = 6
 
 
 def _git_sha() -> Optional[str]:

@@ -50,6 +50,7 @@ solve paths are untouched bitwise.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 import numpy as np
@@ -413,6 +414,79 @@ def _check_conformance(
     if stats is not None:
         stats["conformance"] = summary
     return summary
+
+
+def _note_lanes(
+    lanes, fields_cls, data, axes, batch, out, entry, lane, wall,
+    *, stats=None,
+):
+    """Post-drive lane-decision hook shared by the adaptive entry
+    points: journal one schema-v6 ``lane_decision`` per solved row
+    (`obs.lanes`), with the batched wall amortized across rows, and let
+    the observatory sample shadow probes from the unbatched rows.
+    Purely observational — the solution arrays are returned to the
+    caller untouched, so ``lanes=`` anything is bitwise-neutral on
+    solver results."""
+    from ..obs import health as obs_health
+    from ..obs.lanes import as_lanes
+
+    obs = as_lanes(lanes)
+    if obs is None:
+        return None
+    if stats is not None:
+        stats["lane"] = lane
+    problem = fields_cls(*data)
+    verdicts = obs_health.classify_solution(out) or []
+    its = np.atleast_1d(np.asarray(getattr(out, "iterations", 0)))
+    if batch is None:
+        v = verdicts[0].verdict if verdicts else "healthy"
+        obs.note_solve(
+            problem, lane, entry=entry, wall=wall,
+            iterations=int(its[0]), verdict=v,
+        )
+        return obs
+    share = wall / batch if wall is not None else None
+    for i in range(batch):
+        row = fields_cls(*(
+            np.asarray(f)[i] if ax is not None else f
+            for f, ax in zip(data, axes)
+        ))
+        v = verdicts[i].verdict if i < len(verdicts) else "healthy"
+        obs.note_solve(
+            row, lane, entry=entry, wall=share,
+            iterations=int(its[i]) if i < its.shape[0] else None,
+            verdict=v,
+        )
+    return obs
+
+
+def _relane_advice(lanes, lane_policy, problem, native_lane, batch, trace):
+    """Resolve the opt-in ``lane_policy="advice"`` consultation: returns
+    the advised lane when (and only when) the observatory has
+    hysteresis-settled advice for this problem's family that differs
+    from the native lane AND the solve is a shape the paired lane can
+    take over (unbatched, no trace stitching). Anything else returns
+    None — the native path runs untouched, which is what makes the
+    default bitwise-neutral."""
+    if lane_policy is None or lanes is None:
+        return None
+    if lane_policy != "advice":
+        raise ValueError(
+            f"unknown lane_policy {lane_policy!r} (expected None or 'advice')"
+        )
+    if batch is not None or trace:
+        return None
+    from ..obs.lanes import ALTERNATE, as_lanes
+
+    obs = as_lanes(lanes)
+    if obs is None:
+        return None
+    advised = obs.advice_for(problem)
+    if advised is None or advised == native_lane:
+        return None
+    if ALTERNATE.get(native_lane) != advised:
+        return None
+    return advised
 
 
 def _remedy_info(verdict, outcome) -> dict:
@@ -1116,6 +1190,8 @@ def solve_lp_adaptive(
     remedy=None,
     perf=None,
     conformance=None,
+    lanes=None,
+    lane_policy=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.ipm.solve_lp_batch`: identical
@@ -1149,14 +1225,47 @@ def solve_lp_adaptive(
     drive (and after any remediation), filling
     ``stats["conformance"]`` and the ``solve_residual_*`` histograms.
     Observational only: the returned arrays are bitwise-identical with
-    it on or off."""
+    it on or off.
+
+    `lanes` (True / `LaneConfig` / a `LaneObservatory`) journals a
+    schema-v6 ``lane_decision`` per solved row and samples shadow-lane
+    probes (`obs.lanes`) — observational, bitwise-neutral. With
+    ``lane_policy="advice"`` an unbatched, trace-free solve additionally
+    consults the observatory's hysteresis-settled ``route_advice`` and,
+    when it names the paired PDHG lane, re-lanes through the same
+    program/row mapping as `runtime.remedy`'s lane switch (the advised
+    lane failing to converge falls back to the native path). Default
+    ``lane_policy=None`` never re-lanes."""
     import jax
 
     from ..core.program import LPData
     from ..solvers.ipm import IPMSolution, solve_lp, solve_lp_partial
 
+    t_wall = time.monotonic()
     base_ndim = {"A": 2, "b": 1, "c": 1, "l": 1, "u": 1, "c0": 0}
     axes, batch = _batch_axes(LPData, base_ndim, lp)
+    if _relane_advice(lanes, lane_policy, lp, "dense", batch, trace) == "pdhg":
+        from ..solvers.pdhg import solve_lp_pdhg
+        from .remedy import _ipm_row_from_pdhg, dense_to_sparse
+
+        slp = dense_to_sparse(lp)
+        psol = solve_lp_pdhg(
+            slp, tol=max(float(solver_kw.get("tol") or 1e-6), 1e-6)
+        )
+        if bool(np.asarray(psol.converged)):
+            sol0 = _ipm_row_from_pdhg(psol, lp)
+            if stats is not None:
+                stats["relaned"] = "pdhg"
+            _check_conformance(
+                conformance, LPData, lp, axes, None, sol0, "solve_lp",
+                stats=stats,
+            )
+            _note_lanes(
+                lanes, LPData, lp, axes, None, sol0, "solve_lp", "pdhg",
+                time.monotonic() - t_wall, stats=stats,
+            )
+            return sol0
+        # the advised lane couldn't certify a takeover: native path
     if remedy is not None:
         from .remedy import as_remedy
 
@@ -1167,7 +1276,7 @@ def solve_lp_adaptive(
         )
     if batch is None:
         out0 = solve_lp(lp, warm_start=warm_start, trace=trace, **solver_kw)
-        if remedy is None and conformance is None:
+        if remedy is None and conformance is None and lanes is None:
             return out0
         sol0, tr0 = out0 if trace else (out0, None)
         if remedy is not None:
@@ -1178,6 +1287,10 @@ def solve_lp_adaptive(
         _check_conformance(
             conformance, LPData, lp, axes, None, sol0, "solve_lp",
             stats=stats,
+        )
+        _note_lanes(
+            lanes, LPData, lp, axes, None, sol0, "solve_lp", "dense",
+            time.monotonic() - t_wall, stats=stats,
         )
         return (sol0, tr0) if trace else sol0
     max_iter = solver_kw.get("max_iter", 60)
@@ -1214,6 +1327,10 @@ def solve_lp_adaptive(
     _check_conformance(
         conformance, LPData, lp, axes, batch, out, "solve_lp", stats=stats
     )
+    _note_lanes(
+        lanes, LPData, lp, axes, batch, out, "solve_lp", "dense",
+        time.monotonic() - t_wall, stats=stats,
+    )
     return (out, tr) if trace else out
 
 
@@ -1230,6 +1347,8 @@ def solve_lp_banded_adaptive(
     remedy=None,
     perf=None,
     conformance=None,
+    lanes=None,
+    lane_policy=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.structured.solve_lp_banded_batch`
@@ -1239,17 +1358,21 @@ def solve_lp_banded_adaptive(
     observation-only `conformance` certificate check — which here routes
     through the banded residual kernel, scattering the reduced solution
     back to the flat frame exactly like `optimal_value_banded`; the
-    year-scenario path)."""
+    year-scenario path). `lanes` journals lane decisions; the banded
+    lane has no paired alternate, so `lane_policy="advice"` is accepted
+    but never re-lanes and the observatory never probes these solves."""
     import jax
 
     from ..solvers.ipm import IPMSolution
     from ..solvers.structured import BandedLP, solve_lp_banded
 
+    t_wall = time.monotonic()
     base_ndim = {
         "Ad": 3, "As": 3, "Bb": 3, "b": 2, "c": 2, "cb": 1,
         "l": 2, "u": 2, "lb": 1, "ub": 1, "c0": 0,
     }
     axes, batch = _batch_axes(BandedLP, base_ndim, blp)
+    _relane_advice(lanes, lane_policy, blp, "banded", batch, trace)
     if remedy is not None:
         from .remedy import as_remedy
 
@@ -1264,7 +1387,7 @@ def solve_lp_banded_adaptive(
         out0 = solve_lp_banded(
             meta, blp, warm_start=warm_start, trace=trace, **solver_kw
         )
-        if remedy is None and conformance is None:
+        if remedy is None and conformance is None and lanes is None:
             return out0
         sol0, tr0 = out0 if trace else (out0, None)
         if remedy is not None:
@@ -1275,6 +1398,10 @@ def solve_lp_banded_adaptive(
         _check_conformance(
             conformance, BandedLP, blp, axes, None, sol0,
             "solve_lp_banded", meta=meta, stats=stats,
+        )
+        _note_lanes(
+            lanes, BandedLP, blp, axes, None, sol0, "solve_lp_banded",
+            "banded", time.monotonic() - t_wall, stats=stats,
         )
         return (sol0, tr0) if trace else sol0
     max_iter = solver_kw.get("max_iter", 60)
@@ -1318,6 +1445,10 @@ def solve_lp_banded_adaptive(
         conformance, BandedLP, blp, axes, batch, out, "solve_lp_banded",
         meta=meta, stats=stats,
     )
+    _note_lanes(
+        lanes, BandedLP, blp, axes, batch, out, "solve_lp_banded",
+        "banded", time.monotonic() - t_wall, stats=stats,
+    )
     return (out, tr) if trace else out
 
 
@@ -1333,6 +1464,8 @@ def solve_lp_pdhg_adaptive(
     remedy=None,
     perf=None,
     conformance=None,
+    lanes=None,
+    lane_policy=None,
     **solver_kw,
 ):
     """Adaptive-batch PDHG over a batch of `SparseLP`s sharing one
@@ -1343,17 +1476,42 @@ def solve_lp_pdhg_adaptive(
     the solver — and the `remedy` ladder, whose lane-switch rung re-solves
     a stuck PDHG lane through the dense IPM); `chunk_iters` is rounded up
     to a whole number of convergence-check periods (`check_every`), since
-    the PDHG outer loop only observes the counter between checks."""
+    the PDHG outer loop only observes the counter between checks.
+
+    `lanes` / ``lane_policy="advice"`` mirror `solve_lp_adaptive`: the
+    paired alternate here is the dense IPM lane, reached through
+    `runtime.remedy`'s densify + row mapping."""
     import jax
 
     from ..core.program import SparseLP
     from ..solvers.pdhg import PDHGSolution, solve_lp_pdhg
 
+    t_wall = time.monotonic()
     base_ndim = {
         "rows": 1, "cols": 1, "vals": 1, "b": 1, "c": 1, "l": 1, "u": 1,
         "c0": 0,
     }
     axes, batch = _batch_axes(SparseLP, base_ndim, lps)
+    if _relane_advice(lanes, lane_policy, lps, "pdhg", batch, trace) == "dense":
+        from ..solvers.ipm import solve_lp
+        from .remedy import _pdhg_row_from_ipm, sparse_to_dense
+
+        lp = sparse_to_dense(lps)
+        isol = solve_lp(lp, tol=float(solver_kw.get("tol") or 1e-8))
+        if bool(np.asarray(isol.converged)):
+            sol0 = _pdhg_row_from_ipm(isol, lps)
+            if stats is not None:
+                stats["relaned"] = "dense"
+            _check_conformance(
+                conformance, SparseLP, lps, axes, None, sol0,
+                "solve_lp_pdhg", stats=stats,
+            )
+            _note_lanes(
+                lanes, SparseLP, lps, axes, None, sol0, "solve_lp_pdhg",
+                "dense", time.monotonic() - t_wall, stats=stats,
+            )
+            return sol0
+        # the advised lane couldn't certify a takeover: native path
     if remedy is not None:
         from .remedy import as_remedy
 
@@ -1366,7 +1524,7 @@ def solve_lp_pdhg_adaptive(
         out0 = solve_lp_pdhg(
             lps, warm_start=warm_start, trace=trace, **solver_kw
         )
-        if remedy is None and conformance is None:
+        if remedy is None and conformance is None and lanes is None:
             return out0
         sol0, tr0 = out0 if trace else (out0, None)
         if remedy is not None:
@@ -1377,6 +1535,10 @@ def solve_lp_pdhg_adaptive(
         _check_conformance(
             conformance, SparseLP, lps, axes, None, sol0, "solve_lp_pdhg",
             stats=stats,
+        )
+        _note_lanes(
+            lanes, SparseLP, lps, axes, None, sol0, "solve_lp_pdhg",
+            "pdhg", time.monotonic() - t_wall, stats=stats,
         )
         return (sol0, tr0) if trace else sol0
     if axes[0] == 0 or axes[1] == 0:
@@ -1426,6 +1588,10 @@ def solve_lp_pdhg_adaptive(
     _check_conformance(
         conformance, SparseLP, lps, axes, batch, out, "solve_lp_pdhg",
         stats=stats,
+    )
+    _note_lanes(
+        lanes, SparseLP, lps, axes, batch, out, "solve_lp_pdhg", "pdhg",
+        time.monotonic() - t_wall, stats=stats,
     )
     return (out, tr) if trace else out
 

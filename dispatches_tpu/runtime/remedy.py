@@ -336,15 +336,9 @@ class RemedyEngine:
         classified natively, then mapped back into the IPM row shape
         (bound duals recovered from the reduced costs) so the caller's
         batch stays homogeneous."""
-        from ..core.program import SparseLP
         from ..solvers.pdhg import solve_lp_pdhg
 
-        A = np.asarray(lp.A)
-        rows, cols = np.nonzero(A)
-        slp = SparseLP(
-            rows.astype(np.int32), cols.astype(np.int32),
-            A[rows, cols], lp.b, lp.c, lp.l, lp.u, lp.c0,
-        )
+        slp = dense_to_sparse(lp)
         tol = max(float(self.solver_kw.get("tol") or 1e-6), 1e-6)
         sol = solve_lp_pdhg(slp, tol=tol)
         v = obs_health.classify_solution(sol, budget=100_000)
@@ -354,20 +348,42 @@ class RemedyEngine:
 
     def _switch_to_ipm(self, slp):
         """Sparse PDHG lane -> dense IPM lane (densify the pattern)."""
-        from ..core.program import LPData
         from ..solvers.ipm import solve_lp
 
-        m = int(np.asarray(slp.b).shape[-1])
-        n = int(np.asarray(slp.c).shape[-1])
-        A = np.zeros((m, n), np.asarray(slp.vals).dtype)
-        A[np.asarray(slp.rows), np.asarray(slp.cols)] = np.asarray(slp.vals)
-        lp = LPData(A, slp.b, slp.c, slp.l, slp.u, slp.c0)
+        lp = sparse_to_dense(slp)
         tol = float(self.solver_kw.get("tol") or 1e-8)
         sol = solve_lp(lp, tol=tol)
         v = obs_health.classify_solution(sol, budget=60)
         if v and v[0].verdict in ("healthy", "slow"):
             return _pdhg_row_from_ipm(sol, slp), None
         return sol, 60
+
+
+def dense_to_sparse(lp):
+    """Dense `LPData` row -> the equivalent `SparseLP` (COO over the
+    nonzero pattern of A). The lane-switch rung and the shadow-lane
+    prober (`obs.lanes`) share this mapping so a probed alternate lane
+    solves exactly the program the switch rung would."""
+    from ..core.program import SparseLP
+
+    A = np.asarray(lp.A)
+    rows, cols = np.nonzero(A)
+    return SparseLP(
+        rows.astype(np.int32), cols.astype(np.int32),
+        A[rows, cols], lp.b, lp.c, lp.l, lp.u, lp.c0,
+    )
+
+
+def sparse_to_dense(slp):
+    """Sparse `SparseLP` row -> the equivalent dense `LPData` (densify
+    the COO pattern). Inverse direction of `dense_to_sparse`."""
+    from ..core.program import LPData
+
+    m = int(np.asarray(slp.b).shape[-1])
+    n = int(np.asarray(slp.c).shape[-1])
+    A = np.zeros((m, n), np.asarray(slp.vals).dtype)
+    A[np.asarray(slp.rows), np.asarray(slp.cols)] = np.asarray(slp.vals)
+    return LPData(A, slp.b, slp.c, slp.l, slp.u, slp.c0)
 
 
 def _cast_floats(tree, dtype):

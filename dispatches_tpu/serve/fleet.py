@@ -157,6 +157,8 @@ class FleetService:
         conformance=None,
         canary=None,
         capacity=None,
+        lanes=None,
+        lane_policy=None,
     ):
         if not shards:
             raise ValueError("a fleet needs at least one shard")
@@ -164,7 +166,7 @@ class FleetService:
         self.queue = FairQueue(
             queue_limit, tenants=tenants, default=default_tenant
         )
-        self.router = router or Router()
+        self.router = router or Router(clock=clock)
         self.cache = cache
         self.clock = clock
         self.name = name
@@ -209,6 +211,31 @@ class FleetService:
             from .canary import as_canary
 
             self.canary = as_canary(canary, clock=clock, service=self)
+        # lane observatory (docs/observability.md §14): the parent owns
+        # every request's problem row, so decision records, shadow-lane
+        # probes, and scoreboards all run parent-side — shard children
+        # stay lane-free. Probes tick from pump() after primary dispatch
+        # (batch priority), never on the request path.
+        self.lanes = None
+        if lanes is not None and lanes is not False:
+            from ..obs.lanes import as_lanes
+
+            self.lanes = as_lanes(
+                lanes, clock=clock, conformance=self.conformance,
+                solver_kw=ref.solver_kw,
+            )
+            self.lanes.seed_metrics(name, "dense")
+        # opt-in advice consumption ("advice" routes fingerprint-affine
+        # dispatches toward shards whose declared lane matches the
+        # observatory's settled route_advice; None = never consulted)
+        if lane_policy not in (None, "advice"):
+            raise ValueError(
+                f"unknown lane_policy {lane_policy!r} "
+                "(expected None or 'advice')"
+            )
+        self.lane_policy = lane_policy
+        if lane_policy == "advice" and self.lanes is not None:
+            self.router.advice_fn = self.lanes.advice
         # time-series retention + alerting plane (docs/observability.md
         # §10; off by default and bitwise-neutral for solve results):
         # pump() samples the store on the service clock and evaluates the
@@ -239,6 +266,10 @@ class FleetService:
                 from ..obs.conformance import default_conformance_rules
 
                 rules = list(rules) + default_conformance_rules()
+            if alert_rules is None and self.lanes is not None:
+                from ..obs.lanes import default_lane_rules
+
+                rules = list(rules) + default_lane_rules()
             self.alerts = AlertManager(
                 self.store, rules, clock=clock, slo_fn=slo_fn
             )
@@ -385,6 +416,10 @@ class FleetService:
                 self.canary.tick(now)
             self._dispatch(self.clock())
             done += self._enforce_inflight_deadlines()
+            if self.lanes is not None:
+                # shadow-lane probes run at batch priority: only after
+                # this cycle's primary dispatch and harvests are done
+                self.lanes.tick(self.clock())
             obs_metrics.set_gauge("serve_queue_depth", len(self.queue))
             mono = time.monotonic()
             for slot in self._slots:
@@ -872,8 +907,17 @@ class FleetService:
             self.name, row,
             request_id=req.request_id, seq=req.seq,
             latency_s=latency, iterations=iterations, shard=shard,
+            lane="dense",
             **(warm_attrs or {}), **extra,
         )
+        if self.lanes is not None:
+            # parent-side decision record: the fleet's shard engines are
+            # all dense today; wall is the operator-visible end-to-end
+            # latency (the prober re-measures both lanes before scoring)
+            self.lanes.note_solve(
+                req.problem, "dense", entry=self.name, wall=latency,
+                iterations=iterations, verdict=verdict,
+            )
         if req.journey is not None:
             # started_at re-stamps on every dispatch, so a requeued
             # lane's marks cover only the attempt that answered
@@ -1053,6 +1097,15 @@ class FleetService:
                 return {}
             return self.capacity.report()
 
+    def lane_report(self) -> dict:
+        """The exporter's ``/lanes`` payload: the lane observatory's
+        decision/probe counters, per-family scoreboards, and current
+        route advice. Empty when the plane is off."""
+        with self._lock:
+            if self.lanes is None:
+                return {}
+            return self.lanes.report()
+
     def stats(self) -> dict:
         with self._lock:
             out = {
@@ -1098,6 +1151,8 @@ class FleetService:
                 out["alerts_firing"] = self.alerts.firing()
             if self.capacity is not None:
                 out["capacity"] = self.capacity.report()
+            if self.lanes is not None:
+                out["lanes"] = self.lanes.report()
             for status in ("ok", "cached"):
                 for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
                     v = obs_metrics.histogram_quantile(
@@ -1126,6 +1181,8 @@ def make_dense_fleet(
     conformance=None,
     canary=None,
     capacity=None,
+    lanes=None,
+    lane_policy=None,
     **fleet_kw,
 ) -> FleetService:
     """A `FleetService` of `n_shards` dense-LP shard processes, each
@@ -1163,7 +1220,18 @@ def make_dense_fleet(
     per-shard headroom gauges — ticked from ``pump()`` after each
     store sample; it implies a `SeriesStore` and, like the rest of the
     obs planes, is off by default and bitwise-neutral on solve results
-    (docs/observability.md §13)."""
+    (docs/observability.md §13). ``lanes`` (True / a mapping of
+    `obs.lanes.LaneConfig` knobs / a `LaneObservatory`) attaches the
+    lane observatory: every completed solve emits a ``lane_decision``
+    journal record, a sampled fraction is re-solved on the alternate
+    IPM<->PDHG lane from ``pump()`` (after primary dispatch — batch
+    traffic keeps priority), and regret/win-ratio series feed the
+    ``/lanes`` endpoint plus the `obs.lanes.default_lane_rules` alert
+    pack under ``timeseries=True``. ``lane_policy="advice"`` (default
+    None = off) lets the router's affinity stage consult the
+    observatory's damped ``route_advice`` — observation stays
+    bitwise-neutral; only the explicit opt-in changes routing
+    (docs/observability.md §14)."""
     import os
 
     from ..parallel.mesh import shard_device_env
@@ -1193,6 +1261,6 @@ def make_dense_fleet(
         shards, queue_limit=queue_limit, tenants=tenants, cache=cache,
         clock=clock, reqtrace=reqtrace, spawn=spawn,
         timeseries=timeseries, conformance=conformance, canary=canary,
-        capacity=capacity,
+        capacity=capacity, lanes=lanes, lane_policy=lane_policy,
         **fleet_kw,
     )

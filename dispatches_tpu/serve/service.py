@@ -94,6 +94,8 @@ class DispatchService:
         mem_sample_every: int = 32,
         store=None,
         capacity=None,
+        lanes=None,
+        lane: str = "dense",
     ):
         self.engine = engine
         self.queue = AdmissionQueue(queue_limit)
@@ -115,6 +117,17 @@ class DispatchService:
         # the default): tick() runs from pump() after the store sample —
         # pure reads of retained telemetry, bitwise-neutral on results
         self.capacity = capacity
+        # obs.lanes.LaneObservatory (None = lane observatory off, the
+        # default): every resolved solve journals a lane_decision and may
+        # be sampled for a shadow-lane probe; tick() runs the budgeted
+        # probes from pump() after primary dispatch (batch priority).
+        # Observation-only — results stay bitwise-identical.
+        from ..obs.lanes import as_lanes
+
+        self.lanes = as_lanes(lanes, clock=clock)
+        self.lane = str(lane)
+        if self.lanes is not None:
+            self.lanes.seed_metrics(self.name, self.lane)
         self._pump_count = 0
         self._lock = threading.RLock()
         self._seq = 0
@@ -263,6 +276,10 @@ class DispatchService:
                 self.store.maybe_sample(self.clock())
             if self.capacity is not None:
                 self.capacity.tick(self.clock())
+            if self.lanes is not None:
+                # shadow-lane probes run at batch priority: only after
+                # every primary dispatch/harvest of this cycle is done
+                self.lanes.tick(self.clock())
         return done
 
     def drain(
@@ -435,9 +452,19 @@ class DispatchService:
             self.name, row,
             request_id=req.request_id, seq=req.seq,
             latency_s=latency, iterations=stats.get("iterations"),
+            lane=self.lane,
             **({"health": health} if health is not None else {}),
             **warm_attrs,
         )
+        if self.lanes is not None:
+            # the decision record's wall is the request's end-to-end
+            # latency (the operator-visible cost of the route taken);
+            # the shadow prober re-measures both lanes under one clock
+            # before any regret is scored
+            self.lanes.note_solve(
+                req.problem, self.lane, entry=self.name, wall=latency,
+                iterations=stats.get("iterations"), verdict=verdict,
+            )
         if req.journey is not None:
             req.journey.finish(
                 "complete", verdict=verdict,
@@ -527,6 +554,15 @@ class DispatchService:
                 return {}
             return {"conformance": conf.report()}
 
+    def lane_report(self) -> dict:
+        """The exporter's ``/lanes`` payload for the in-process service:
+        the lane observatory's scoreboard ledger. Empty when the plane
+        is off."""
+        with self._lock:
+            if self.lanes is None:
+                return {}
+            return self.lanes.report()
+
     def stats(self) -> dict:
         with self._lock:
             out = {
@@ -549,6 +585,8 @@ class DispatchService:
                 out["timeseries"] = self.store.stats()
             if self.capacity is not None:
                 out["capacity"] = self.capacity.report()
+            if self.lanes is not None:
+                out["lanes"] = self.lanes.report()
             for status in ("ok", "cached"):
                 for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
                     v = obs_metrics.histogram_quantile(
@@ -574,6 +612,7 @@ def make_dense_service(
     remedy=None,
     conformance=None,
     capacity=None,
+    lanes=None,
     **solver_kw,
 ) -> DispatchService:
     """A `DispatchService` over dense `LPData` rows solved by the IPM:
@@ -614,7 +653,14 @@ def make_dense_service(
     bitwise-identical) attaches the capacity observatory — measured
     service laws, the deterministic fleet twin, and the
     `fleet_desired_shards` / headroom gauges — ticked from `pump()`;
-    implies a `SeriesStore` (docs/observability.md §13)."""
+    implies a `SeriesStore` (docs/observability.md §13).
+
+    `lanes` (True / `obs.lanes.LaneConfig` knobs mapping / a
+    `LaneObservatory`; default None = lane observatory off,
+    bitwise-identical) journals a ``lane_decision`` per resolved solve
+    and runs budgeted shadow-lane probes from `pump()` — measured
+    routing regret, per-family scoreboards, and the `route_advice`
+    gauge (docs/observability.md §14)."""
     from ..runtime.adaptive import make_dense_engine
 
     remedy_engine = None
@@ -657,4 +703,5 @@ def make_dense_service(
     return DispatchService(
         engine, queue_limit=queue_limit, cache=cache, clock=clock,
         reqtrace=reqtrace, store=store, capacity=observatory,
+        lanes=lanes, lane="dense",
     )

@@ -1,0 +1,677 @@
+"""Observability pillar 14: the lane observatory (`obs.lanes`) —
+schema-v6 routing decision records, the shadow-lane regret prober
+(IPM <-> PDHG via `runtime.remedy`'s lane mapping), the per-(family,
+lane) scoreboards and hysteresis-damped advice, the exporter's
+``/lanes`` route, the router's advice preference + affinity TTL, the
+dataset-export bridge into `learn.dataset`, and the trace_summary lane
+column/footer. Probe math runs on instrumented observatories (injected
+solvers + fake clocks) so the hysteresis and regret arithmetic are
+exact; the deliberately-real tests (actual IPM/PDHG re-solves and the
+bitwise-neutrality check at the adaptive entry) stay small because each
+pays a jax compile."""
+import importlib
+import io
+import json
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData
+from dispatches_tpu.learn.dataset import (
+    family_fingerprint,
+    features_of,
+    load_dataset,
+)
+from dispatches_tpu.obs.exporter import TelemetryExporter
+from dispatches_tpu.obs.journal import Tracer, use_tracer
+from dispatches_tpu.obs import metrics as obs_metrics
+from dispatches_tpu.obs.lanes import (
+    ALTERNATE,
+    LANE_CODES,
+    PROBE_OUTCOMES,
+    LaneConfig,
+    LaneObservatory,
+    as_lanes,
+    default_lane_rules,
+    lane_of,
+)
+from dispatches_tpu.obs.metrics import reset_metrics
+from dispatches_tpu.runtime.adaptive import solve_lp_adaptive
+from dispatches_tpu.runtime.remedy import dense_to_sparse, sparse_to_dense
+from dispatches_tpu.serve import Router, SolveRequest
+
+
+# one shared A across seeds: family_fingerprint hashes the non-varying
+# fields, so rows must share A (vary only b, c) to probe as one family
+_RNG = np.random.default_rng(0)
+_A = _RNG.normal(size=(3, 6))
+
+
+def _lp(seed, dtype=jnp.float64):
+    r = np.random.default_rng(100 + seed)
+    x0 = r.uniform(0.5, 1.5, size=6)
+    return LPData(
+        jnp.asarray(_A, dtype), jnp.asarray(_A @ x0, dtype),
+        jnp.asarray(r.normal(size=6), dtype),
+        jnp.zeros(6, dtype), jnp.full(6, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+class Clk:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _instrument(obs, ctl, clk=None):
+    """Replace the observatory's lane solvers with deterministic stubs
+    driven by the `ctl` dict (walls/objs/convergence per lane), so
+    probe scoring and hysteresis are tested as exact arithmetic. The
+    KKT checker is disabled — stub solutions carry no certifiable x."""
+
+    def mk(lane):
+        def f(problem):
+            if ctl.get(f"raise_{lane}"):
+                raise RuntimeError("injected solver failure")
+            wall = float(ctl[f"wall_{lane}"])
+            if clk is not None:
+                clk.advance(wall)
+            sol = SimpleNamespace(
+                x=np.zeros(6),
+                iterations=int(ctl.get(f"iters_{lane}", 5)),
+                obj=float(ctl.get(f"obj_{lane}", -1.0)),
+                converged=bool(ctl.get(f"conv_{lane}", True)),
+            )
+            return sol, wall
+        return f
+
+    obs._solve_dense = mk("dense")
+    obs._solve_pdhg = mk("pdhg")
+    obs.checker = None
+    return obs
+
+
+def _fake_obs(ctl, clk=None, **cfg):
+    cfg.setdefault("probe_fraction", 1.0)
+    cfg.setdefault("min_probes", 3)
+    cfg.setdefault("hold", 2)
+    cfg.setdefault("warm_probes", False)
+    obs = LaneObservatory(
+        LaneConfig(**cfg), clock=clk if clk is not None else Clk()
+    )
+    return _instrument(obs, ctl, clk)
+
+
+# ---------------------------------------------------------------------
+# config + coercion
+# ---------------------------------------------------------------------
+class TestConfigCoercion:
+    def test_from_mapping_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown LaneConfig"):
+            LaneConfig.from_mapping({"probe_fractoin": 0.5})
+
+    def test_as_lanes_coercions(self):
+        assert as_lanes(None) is None
+        assert as_lanes(False) is None
+        obs = as_lanes(True)
+        assert isinstance(obs, LaneObservatory)
+        assert as_lanes(obs) is obs  # pass-through, state preserved
+        cfg = LaneConfig(probe_fraction=0.5)
+        assert as_lanes(cfg).config.probe_fraction == 0.5
+        assert as_lanes({"min_probes": 9}).config.min_probes == 9
+        with pytest.raises(TypeError):
+            as_lanes(3)
+
+    def test_lane_of(self):
+        assert lane_of(_lp(0)) == "dense"
+        assert lane_of(dense_to_sparse(_lp(0))) == "pdhg"
+        assert lane_of(object()) is None
+
+    def test_alternate_pairs_mirror_remedy(self):
+        # the probe mapping must stay the remedy lane-switch pairing:
+        # dense<->pdhg, banded unpaired
+        assert ALTERNATE == {"dense": "pdhg", "pdhg": "dense"}
+        assert "banded" not in ALTERNATE
+        assert set(LANE_CODES) == {"dense", "pdhg", "banded"}
+
+
+# ---------------------------------------------------------------------
+# decision records
+# ---------------------------------------------------------------------
+class TestDecisionRecords:
+    def test_note_solve_journals_and_counts(self):
+        reset_metrics()
+        obs = _fake_obs({}, probe_fraction=0.0)
+        lp = _lp(1)
+        with use_tracer(Tracer(None)) as tr:
+            attrs = obs.note_solve(
+                lp, "dense", entry="unit", wall=0.125, iterations=7,
+                verdict="healthy",
+            )
+        assert attrs["lane"] == "dense" and attrs["entry"] == "unit"
+        assert attrs["family"] == family_fingerprint(lp)
+        assert attrs["wall_s"] == 0.125 and attrs["iterations"] == 7
+        assert attrs["feature_dim"] == features_of(lp).size
+        assert len(attrs["feature_preview"]) <= obs.config.feature_preview
+        evs = [e for e in tr.events if e.get("name") == "lane_decision"]
+        assert len(evs) == 1 and evs[0]["family"] == attrs["family"]
+        assert obs_metrics.flat_values()[
+            'lane_decisions_total{entry="unit",lane="dense"}'
+        ] == 1.0
+
+    def test_exotic_problem_never_raises(self):
+        obs = _fake_obs({})
+        with use_tracer(Tracer(None)) as tr:
+            assert obs.note_solve(object(), entry="unit") is None
+        assert not [e for e in tr.events if e.get("name") == "lane_decision"]
+
+    def test_zero_seeded_counters(self):
+        reset_metrics()
+        obs = _fake_obs({}, probe_fraction=0.0)
+        obs.seed_metrics("serve_fleet", "dense")
+        flat = obs_metrics.flat_values()
+        assert flat[
+            'lane_decisions_total{entry="serve_fleet",lane="dense"}'
+        ] == 0.0
+        for outcome in PROBE_OUTCOMES:
+            assert flat[
+                f'lane_shadow_probes_total{{outcome="{outcome}"}}'
+            ] == 0.0
+
+    def test_probe_eligibility(self):
+        # probe_fraction=1.0: every eligible solve enqueues. Unhealthy
+        # verdicts and the unpaired banded lane never do.
+        obs = _fake_obs({})
+        with use_tracer(Tracer(None)):
+            obs.note_solve(_lp(2), "dense", entry="unit")
+            assert obs.due()
+            obs.run_probes()  # drain so the next assertions start clean
+            obs.note_solve(_lp(2), "dense", entry="unit",
+                           verdict="diverged")
+            assert not obs.due()
+            obs.note_solve(_lp(2), "banded", entry="unit")
+            assert not obs.due()
+
+    def test_default_lane_rules_regret_burn(self):
+        rules = default_lane_rules()
+        names = [getattr(r, "name", None) for r in rules]
+        assert "lane_regret_burn" in names
+
+
+# ---------------------------------------------------------------------
+# probe scoring (exact arithmetic on instrumented observatories)
+# ---------------------------------------------------------------------
+class TestProbeScoring:
+    def test_regret_math_fake_clock(self):
+        reset_metrics()
+        clk = Clk()
+        ctl = {"wall_dense": 1.0, "wall_pdhg": 0.2}
+        obs = _fake_obs(ctl, clk)
+        lp = _lp(3)
+        fam = family_fingerprint(lp)
+        with use_tracer(Tracer(None)) as tr:
+            obs.note_solve(lp, "dense", entry="unit")
+            recs = obs.run_probes()
+        assert len(recs) == 1
+        rec = recs[0]
+        # chosen dense wall 1.0 vs alt pdhg 0.2: regret is exactly the
+        # wall difference, and 0.2 < 1.0 * (1 - 0.20) clears the margin
+        assert rec["outcome"] == "regret"
+        assert rec["regret_s"] == pytest.approx(0.8)
+        assert rec["wall_chosen"] == 1.0 and rec["wall_alt"] == 0.2
+        assert rec["fingerprint"].startswith("__laneprobe__")
+        ev = [e for e in tr.events if e.get("name") == "lane_probe"]
+        assert len(ev) == 1 and ev[0]["outcome"] == "regret"
+        flat = obs_metrics.flat_values()
+        assert flat[
+            f'lane_shadow_probes_total{{family="{fam[:8]}",'
+            f'outcome="regret"}}'
+        ] == 1.0
+        q = obs_metrics.histogram_quantile(
+            "lane_regret_seconds", 0.95, family=fam[:8]
+        )
+        assert q is not None and q > 0
+        # the fake solvers advance the clock by their walls, so the
+        # observatory's own cost ledger is the probe's total re-solve
+        assert flat["lane_probe_wall_seconds_total"] == pytest.approx(1.2)
+
+    def test_chosen_best_within_margin(self):
+        obs = _fake_obs({"wall_dense": 1.0, "wall_pdhg": 0.95})
+        with use_tracer(Tracer(None)):
+            obs.note_solve(_lp(3), "dense", entry="unit")
+            (rec,) = obs.run_probes()
+        # alt faster but not by regret_rel_margin: not a mispredict
+        assert rec["outcome"] == "chosen_best"
+        assert "regret_s" in rec  # raw wall gap still recorded
+
+    def test_mismatch_beats_regret(self):
+        obs = _fake_obs({"wall_dense": 1.0, "wall_pdhg": 0.1,
+                         "obj_dense": -1.0, "obj_pdhg": -1.5})
+        with use_tracer(Tracer(None)):
+            obs.note_solve(_lp(3), "dense", entry="unit")
+            (rec,) = obs.run_probes()
+        # lanes disagreeing in optimum can't generate regret
+        assert rec["outcome"] == "mismatch"
+        assert "regret_s" not in rec
+        assert obs.scoreboard() == {}  # mismatches never feed the board
+
+    def test_alt_failed_on_divergence(self):
+        obs = _fake_obs({"wall_dense": 1.0, "wall_pdhg": 0.1,
+                         "conv_pdhg": False})
+        lp = _lp(3)
+        with use_tracer(Tracer(None)):
+            obs.note_solve(lp, "dense", entry="unit")
+            (rec,) = obs.run_probes()
+        assert rec["outcome"] == "alt_failed"
+        board = obs.scoreboard()[family_fingerprint(lp)]
+        # an unusable alternate scores a win for the route taken
+        assert board["lanes"]["dense"]["wins"] == 1
+        assert board["lanes"]["pdhg"]["wins"] == 0
+
+    def test_error_outcome_contained(self):
+        obs = _fake_obs({"wall_dense": 1.0, "wall_pdhg": 0.1,
+                         "raise_pdhg": True})
+        with use_tracer(Tracer(None)):
+            obs.note_solve(_lp(3), "dense", entry="unit")
+            (rec,) = obs.run_probes()
+        assert rec["outcome"] == "error"
+        assert "injected solver failure" in rec["error"]
+
+    def test_tick_budget_is_batch_priority(self):
+        obs = _fake_obs({"wall_dense": 1.0, "wall_pdhg": 0.2},
+                        max_probes_per_tick=1)
+        with use_tracer(Tracer(None)):
+            for i in range(3):
+                obs.note_solve(_lp(3 + i), "dense", entry="unit")
+            assert len(obs.tick()) == 1  # one probe per pump cycle
+            assert len(obs.tick()) == 1
+            assert len(obs.run_probes()) == 1  # drain the rest
+            assert obs.tick() == []
+
+    def test_report_and_win_ratio_gauges(self):
+        reset_metrics()
+        obs = _fake_obs({"wall_dense": 1.0, "wall_pdhg": 0.2})
+        lp = _lp(3)
+        fam = family_fingerprint(lp)
+        with use_tracer(Tracer(None)):
+            for _ in range(4):
+                obs.note_solve(lp, "dense", entry="unit")
+            obs.run_probes()
+        rep = obs.report()
+        assert rep["decisions"] == 4 and rep["probes_run"] == 4
+        assert rep["outcomes"] == {"regret": 4}
+        board = rep["scoreboard"][fam]
+        assert board["lanes"]["pdhg"]["win_ratio"] == 1.0
+        assert board["lanes"]["dense"]["win_ratio"] == 0.0
+        assert obs_metrics.sum_gauges(
+            "lane_win_ratio", family=fam[:8], lane="pdhg"
+        ) == 1.0
+
+
+# ---------------------------------------------------------------------
+# advice hysteresis
+# ---------------------------------------------------------------------
+class TestAdviceHysteresis:
+    def _probe(self, obs, lp, n=1):
+        for _ in range(n):
+            obs.note_solve(lp, "dense", entry="unit")
+            obs.run_probes()
+
+    def test_min_probes_then_flip_needs_margin_and_hold(self):
+        reset_metrics()
+        ctl = {"wall_dense": 1.0, "wall_pdhg": 0.2}
+        obs = _fake_obs(ctl, min_probes=3, hold=2, flip_margin=0.10)
+        lp = _lp(4)
+        fam = family_fingerprint(lp)
+        with use_tracer(Tracer(None)) as tr:
+            self._probe(obs, lp, 2)
+            assert obs.advice(fam) is None  # below min_probes
+            self._probe(obs, lp)
+            assert obs.advice(fam) == "pdhg"  # first advice, no streak
+            assert obs.advice_for(lp) == "pdhg"
+            assert obs_metrics.sum_gauges(
+                "route_advice", family=fam[:8]
+            ) == LANE_CODES["pdhg"]
+            # dense starts winning: ratios cross + clear the 0.10
+            # margin at probe 7 (4/7 vs 3/7), hold=2 delays the flip
+            # by one more probe — exactly two evaluations over margin
+            ctl["wall_dense"], ctl["wall_pdhg"] = 0.2, 1.0
+            self._probe(obs, lp, 4)
+            assert obs.advice(fam) == "pdhg"  # margin met once: held
+            self._probe(obs, lp)
+            assert obs.advice(fam) == "dense"  # second consecutive: flip
+            flips = [e for e in tr.events
+                     if e.get("name") == "lane_advice_flip"]
+            assert len(flips) == 1
+            assert flips[0]["previous"] == "pdhg"
+            assert flips[0]["lane"] == "dense"
+        assert obs_metrics.sum_gauges(
+            "route_advice", family=fam[:8]
+        ) == LANE_CODES["dense"]
+
+    def test_force_advice_pins_and_unpins(self):
+        reset_metrics()
+        ctl = {"wall_dense": 0.2, "wall_pdhg": 1.0}
+        obs = _fake_obs(ctl)
+        lp = _lp(5)
+        fam = family_fingerprint(lp)
+        with use_tracer(Tracer(None)):
+            obs.force_advice(fam, "pdhg")
+            assert obs.advice(fam) == "pdhg"
+            # measured dense wins cannot move a pinned route
+            self._probe(obs, lp, 6)
+            assert obs.advice(fam) == "pdhg"
+            assert obs.scoreboard()[fam]["forced"] == "pdhg"
+            obs.force_advice(fam, None)
+            self._probe(obs, lp, 2)
+            assert obs.advice(fam) == "dense"  # evidence wins once unpinned
+        with pytest.raises(ValueError, match="unknown lane"):
+            obs.force_advice(fam, "warp")
+
+
+# ---------------------------------------------------------------------
+# real probes: lane mapping round trip + bitwise neutrality
+# ---------------------------------------------------------------------
+class TestRealProbes:
+    def test_remedy_mapping_round_trip(self):
+        lp = _lp(6)
+        rt = sparse_to_dense(dense_to_sparse(lp))
+        for a, b in zip(lp, rt):
+            assert _biteq(a, b)
+
+    def test_real_probe_lanes_agree(self):
+        # one real IPM + PDHG re-solve pair: whatever the walls say,
+        # the two lanes must agree in optimum (the probe's conformance
+        # cross-check would otherwise score mismatch/alt_failed)
+        reset_metrics()
+        obs = LaneObservatory(
+            LaneConfig(probe_fraction=1.0), solver_kw={"max_iter": 200}
+        )
+        with use_tracer(Tracer(None)) as tr:
+            obs.note_solve(_lp(6), "dense", entry="unit")
+            (rec,) = obs.run_probes()
+        assert rec["outcome"] in ("chosen_best", "regret", "alt_failed")
+        if rec["outcome"] != "alt_failed":
+            denom = max(abs(rec["obj_chosen"]), abs(rec["obj_alt"]), 1.0)
+            assert abs(rec["obj_chosen"] - rec["obj_alt"]) / denom <= 1e-4
+            assert rec["wall_chosen"] >= 0 and rec["wall_alt"] >= 0
+            assert rec["iters_chosen"] > 0 and rec["iters_alt"] > 0
+        assert [e for e in tr.events if e.get("name") == "lane_probe"]
+
+    def test_adaptive_entry_bitwise_neutral_with_probing(self):
+        # the acceptance bar: solver results bitwise identical with the
+        # plane off AND with probing actually running
+        lp = _lp(7)
+        base = solve_lp_adaptive(lp, max_iter=60, tol=1e-8)
+        obs = as_lanes({"probe_fraction": 1.0})
+        with use_tracer(Tracer(None)) as tr:
+            stats = {}
+            on = solve_lp_adaptive(
+                lp, max_iter=60, tol=1e-8, lanes=obs, stats=stats,
+            )
+            assert obs.due()
+            obs.run_probes()  # probes actually execute...
+            again = solve_lp_adaptive(lp, max_iter=60, tol=1e-8, lanes=obs)
+        assert _biteq(base.x, on.x) and _biteq(base.x, again.x)
+        assert _biteq(base.obj, on.obj)
+        assert _biteq(base.iterations, on.iterations)
+        assert stats["lane"] == "dense"
+        decs = [e for e in tr.events if e.get("name") == "lane_decision"]
+        assert len(decs) == 2 and all(d["entry"] == "solve_lp" for d in decs)
+
+
+# ---------------------------------------------------------------------
+# exporter /lanes route
+# ---------------------------------------------------------------------
+class TestExporterRoute:
+    def test_404_until_attached_then_report(self):
+        ex = TelemetryExporter()  # never started: handle_path only
+        status, _, body = ex.handle_path("/lanes")
+        assert status == 404 and b"no lane observatory" in body
+        ex.lanes_fn = lambda: {"decisions": 3, "scoreboard": {}}
+        status, ctype, body = ex.handle_path("/lanes")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body)["decisions"] == 3
+
+    def test_broken_callback_is_500_not_fatal(self):
+        ex = TelemetryExporter()
+
+        def boom():
+            raise RuntimeError("lane report broke")
+
+        ex.lanes_fn = boom
+        status, _, body = ex.handle_path("/lanes")
+        assert status == 500 and b"lane report broke" in body
+
+
+# ---------------------------------------------------------------------
+# dataset export -> learn.dataset ingest
+# ---------------------------------------------------------------------
+class TestDatasetExport:
+    def test_export_loads_as_training_shard(self, tmp_path):
+        reset_metrics()
+        obs = _fake_obs({"wall_dense": 1.0, "wall_pdhg": 0.2,
+                         "iters_dense": 30, "iters_pdhg": 120})
+        lp = _lp(8)
+        fam = family_fingerprint(lp)
+        with use_tracer(Tracer(None)) as tr:
+            for _ in range(3):
+                obs.note_solve(lp, "dense", entry="unit")
+            obs.run_probes()
+            paths = obs.export_dataset(str(tmp_path))
+        assert len(paths) == 1 and paths[0].endswith(".npz")
+        ds = load_dataset(paths)
+        assert ds.family == fam
+        assert ds.X.shape == (3, features_of(lp).size)
+        assert [t[0] for t in ds.targets] == [
+            "wall_dense", "wall_pdhg", "iters_dense", "iters_pdhg",
+            "chosen",
+        ]
+        assert np.all(ds.Y[:, 0] == 1.0)  # wall_dense
+        assert np.all(ds.Y[:, 1] == 0.2)  # wall_pdhg
+        assert np.all(ds.Y[:, 4] == LANE_CODES["dense"])  # route taken
+        shard_evs = [e for e in tr.events
+                     if e.get("name") == "dataset_shard"]
+        assert len(shard_evs) == 1 and shard_evs[0]["rows"] == 3
+
+
+# ---------------------------------------------------------------------
+# router: lane-advice preference + affinity TTL
+# ---------------------------------------------------------------------
+class _Shard:
+    def __init__(self, shard_id, bucket=4, inflight=0, lane=None):
+        self.shard_id = shard_id
+        self.bucket = bucket
+        self._n = inflight
+        if lane is not None:
+            self.lane = lane
+
+    def inflight(self):
+        return self._n
+
+
+def _req(priority=1, fingerprint=None, family=None):
+    r = SolveRequest(None, priority=priority, fingerprint=fingerprint)
+    if family is not None:
+        # SolveRequest is __slots__'d without `family`; heterogeneous
+        # fleets will carry it on their request type, the router only
+        # getattr-probes for it
+        r = SimpleNamespace(
+            priority=priority, fingerprint=fingerprint, family=family
+        )
+    return r
+
+
+class TestRouterAdvice:
+    def test_advice_prefers_matching_lane(self):
+        r = Router()
+        r.advice_fn = lambda fam: "pdhg"
+        dense = _Shard(0, inflight=0, lane="dense")
+        pdhg = _Shard(1, inflight=1, lane="pdhg")
+        # advised lane wins even against a less-loaded dense shard
+        assert r.pick(_req(family="f"), [dense, pdhg]) is pdhg
+
+    def test_advice_falls_back_when_no_lane_matches(self):
+        r = Router()
+        r.advice_fn = lambda fam: "banded"
+        shards = [_Shard(0, inflight=1, lane="dense"),
+                  _Shard(1, inflight=0, lane="dense")]
+        assert r.pick(_req(family="f"), shards).shard_id == 1
+
+    def test_no_family_or_no_advice_is_neutral(self):
+        r = Router()
+        r.advice_fn = lambda fam: None
+        shards = [_Shard(0, inflight=1, lane="dense"),
+                  _Shard(1, inflight=0, lane="pdhg")]
+        assert r.pick(_req(family="f"), shards).shard_id == 1
+        r.advice_fn = lambda fam: "pdhg"
+        # a plain SolveRequest exposes no family: advice never consulted
+        assert r.pick(_req(), shards).shard_id == 1
+
+
+class TestRouterAffinityTTL:
+    def test_two_family_rotation_expires_stale_affinity(self):
+        # a workload that rotates between families must not keep
+        # pinning to a shard whose warmth evaporated a rotation ago
+        clk = Clk()
+        r = Router(affinity_ttl=5.0, affinity_slack=4, clock=clk)
+        warm = _Shard(0, inflight=1)
+        cold = _Shard(1, inflight=0)
+        r.note_dispatch(_req(fingerprint="fam-a"), warm)
+        clk.advance(3.0)
+        # within TTL: affinity (within slack) still wins
+        assert r.pick(_req(fingerprint="fam-a"), [warm, cold]) is warm
+        # family B occupies the fleet past family A's TTL
+        r.note_dispatch(_req(fingerprint="fam-b"), cold)
+        clk.advance(5.5)
+        # A's entry is stale: least-loaded wins, and the lookup evicted it
+        assert r.pick(_req(fingerprint="fam-a"), [warm, cold]) is cold
+        assert "fam-a" not in r._aff
+
+    def test_sweep_bounds_table_below_capacity(self):
+        clk = Clk()
+        r = Router(affinity_ttl=5.0, clock=clk)
+        shard = _Shard(0)
+        for i in range(20):
+            clk.t = float(i)
+            r.note_dispatch(_req(fingerprint=f"fp{i}"), shard)
+        # entries older than the TTL were swept on dispatch, long
+        # before the capacity bound would have engaged
+        assert set(r._aff) == {f"fp{i}" for i in range(14, 20)}
+
+    def test_redispatch_restamps(self):
+        clk = Clk()
+        r = Router(affinity_ttl=5.0, affinity_slack=4, clock=clk)
+        warm, cold = _Shard(0, inflight=1), _Shard(1, inflight=0)
+        r.note_dispatch(_req(fingerprint="fp"), warm)
+        for _ in range(3):
+            clk.advance(3.0)  # each dispatch refreshes the stamp
+            r.note_dispatch(_req(fingerprint="fp"), warm)
+        assert r.pick(_req(fingerprint="fp"), [warm, cold]) is warm
+
+    def test_no_ttl_keeps_historical_behavior(self):
+        clk = Clk()
+        r = Router(affinity_slack=4, clock=clk)
+        warm, cold = _Shard(0, inflight=1), _Shard(1, inflight=0)
+        r.note_dispatch(_req(fingerprint="fp"), warm)
+        clk.advance(1e9)
+        assert r.pick(_req(fingerprint="fp"), [warm, cold]) is warm
+
+    def test_capacity_eviction_with_tuple_entries(self):
+        r = Router(affinity_capacity=2)
+        shard = _Shard(0)
+        for i in range(3):
+            r.note_dispatch(_req(fingerprint=f"fp{i}"), shard)
+        assert set(r._aff) == {"fp1", "fp2"}
+        r.forget_shard(0)
+        assert not r._aff
+
+
+# ---------------------------------------------------------------------
+# trace_summary: lane column + lanes footer, pre-v6 neutrality
+# ---------------------------------------------------------------------
+def _base_journal():
+    return [
+        {"kind": "manifest", "run_id": "r1", "schema_version": 4,
+         "git_sha": "cafe", "device_kind": "cpu", "device_count": 1},
+        {"kind": "span_start", "span": "solve", "ts": 0.0, "mono": 0.0},
+        {"kind": "span_end", "span": "solve", "ok": True, "wall_s": 0.5},
+    ]
+
+
+def _solve_record(**extra):
+    rec = {"kind": "solve", "name": "solve_lp", "span": "solve",
+           "stats": {"batch": 1, "converged_frac": 1.0,
+                     "iterations": {"min": 5, "max": 5, "median": 5}}}
+    rec.update(extra)
+    return rec
+
+
+def _render(tmp_path, records):
+    ts = importlib.import_module("tools.trace_summary")
+    p = tmp_path / "j.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    out = io.StringIO()
+    rc = ts.main([str(p)], out=out)
+    return rc, out.getvalue()
+
+
+class TestTraceSummaryLanes:
+    def test_pre_v6_renders_without_lane_surface(self, tmp_path):
+        rc, txt = _render(tmp_path, _base_journal() + [_solve_record()])
+        assert rc == 0
+        assert " lane=" not in txt and "lanes " not in txt
+
+    def test_lane_column_and_footer(self, tmp_path):
+        recs = _base_journal() + [
+            _solve_record(lane="dense"),
+            {"kind": "event", "name": "lane_decision", "span": "solve",
+             "family": "famA" + "x" * 12, "lane": "dense",
+             "verdict": "healthy"},
+            {"kind": "event", "name": "lane_decision", "span": "solve",
+             "family": "famA" + "x" * 12, "lane": "dense",
+             "verdict": "healthy"},
+            {"kind": "event", "name": "lane_decision", "span": "solve",
+             "family": "famA" + "x" * 12, "lane": "pdhg",
+             "verdict": "healthy"},
+            {"kind": "event", "name": "lane_probe", "span": "solve",
+             "family": "famA" + "x" * 12, "outcome": "regret",
+             "regret_s": 0.5},
+            {"kind": "event", "name": "lane_probe", "span": "solve",
+             "family": "famA" + "x" * 12, "outcome": "chosen_best"},
+        ]
+        rc, txt = _render(tmp_path, recs)
+        assert rc == 0
+        assert " lane=dense" in txt
+        assert "lanes famAxxxxxxx" in txt
+        assert "dense=2(67%)" in txt and "pdhg=1(33%)" in txt
+        assert "probes[chosen_best=1,regret=1]" in txt
+        assert "regret=0.5000s" in txt
+
+    def test_lane_events_do_not_double_count_health(self, tmp_path):
+        # lane_decision carries the solve's verdict; the health footer
+        # must count the solve once, not once per echo
+        recs = _base_journal() + [
+            _solve_record(health={
+                "counts": {"diverged": 1},
+                "worst": {"lane": 0, "verdict": "diverged"},
+            }),
+            {"kind": "event", "name": "lane_decision", "span": "solve",
+             "family": "famA", "lane": "dense", "verdict": "diverged"},
+        ]
+        rc, txt = _render(tmp_path, recs)
+        assert rc == 0
+        assert "health: diverged=1" in txt

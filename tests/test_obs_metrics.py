@@ -276,8 +276,8 @@ class TestCostModel:
 class TestJournalV2:
     def test_manifest_schema_version_and_mono(self):
         tracer = Tracer(None)
-        # v4: compile_event records (obs.perf compile telemetry)
-        assert tracer.manifest["schema_version"] == 4
+        # v6: lane_decision/lane_probe records (obs.lanes)
+        assert tracer.manifest["schema_version"] == 6
         assert tracer.manifest["clock"] == "perf_counter"
         with tracer.span("a"):
             pass

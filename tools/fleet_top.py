@@ -39,6 +39,15 @@ in live mode from the ``/capacity`` report: per-shard headroom bars
 and model-validation error, and a time-to-SLO-breach countdown when the
 forecast is finite. Observatory-off fleets show no panel.
 
+When the fleet runs the lane observatory (docs/observability.md §14,
+``make_dense_fleet(..., lanes=True)``), a lanes panel appears in live
+mode from the ``/lanes`` report: one scoreboard row per problem family
+(per-lane wins/probes with win ratio and wall p95, the current damped
+``route_advice`` — flagged ``(forced)`` when pinned), and a totals line
+with decision/probe counts and the probe outcome tally, ``REGRET``
+capitalized when the prober has caught the router on the slower lane.
+Observatory-off fleets show no panel.
+
 Stdlib-only on purpose (same contract as journal_diff/trace_timeline):
 pointing this at a production fleet must not import jax. The series
 parser and histogram quantile mirror `obs.metrics` exactly —
@@ -387,6 +396,48 @@ def capacity_lines(cap: Optional[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def lanes_lines(lanes: Optional[Dict[str, Any]]) -> List[str]:
+    """The lanes panel (docs/observability.md §14) from a ``/lanes``
+    report: per-family scoreboard rows (per-lane wins/probes, win
+    ratio, wall p95, the damped advice — ``(forced)`` when pinned) and
+    a totals line with the probe outcome tally. Empty (no panel) when
+    the observatory is off — the report dict is empty then."""
+    if not lanes:
+        return []
+    lines = ["lanes"]
+    for fam, row in sorted((lanes.get("scoreboard") or {}).items()):
+        bits = [f"  {fam[:12]:<12}"]
+        for lane, st in sorted((row.get("lanes") or {}).items()):
+            cell = f"{lane} {int(st.get('wins', 0))}/{int(st.get('probes', 0))}"
+            if st.get("win_ratio") is not None:
+                cell += f" win={st['win_ratio']:.2f}"
+            if st.get("wall_p95") is not None:
+                cell += f" p95={st['wall_p95'] * 1e3:.1f}ms"
+            bits.append(cell)
+        adv = row.get("advice")
+        if adv:
+            bits.append(
+                f"advice={adv}" + (" (forced)" if row.get("forced") else "")
+            )
+        lines.append("  ".join(bits))
+    outcomes = lanes.get("outcomes") or {}
+    bits = [
+        f"decisions={int(lanes.get('decisions', 0))}",
+        f"probes={int(lanes.get('probes_run', 0))}",
+    ]
+    regret = int(outcomes.get("regret", 0))
+    if regret:
+        bits.append(f"REGRET={regret}")
+    for k in ("chosen_best", "mismatch", "alt_failed", "error"):
+        if outcomes.get(k):
+            bits.append(f"{k}={int(outcomes[k])}")
+    pending = int(lanes.get("pending_probes", 0))
+    if pending:
+        bits.append(f"pending={pending}")
+    lines.append("  " + "  ".join(bits))
+    return lines
+
+
 def alert_lines(alerts: Optional[Dict[str, Any]]) -> List[str]:
     """The firing-alerts panel from an ``/alerts`` report: one row per
     firing instance, plus a one-line OK when the pack is quiet."""
@@ -418,6 +469,7 @@ def render(
     queries: Optional[Dict[str, Optional[Dict[str, Any]]]] = None,
     alerts: Optional[Dict[str, Any]] = None,
     capacity: Optional[Dict[str, Any]] = None,
+    lanes: Optional[Dict[str, Any]] = None,
 ) -> str:
     rows = fleet_rows(snap, health, prev, dt)
     n_down = sum(1 for r in rows if not r["up"])
@@ -472,6 +524,7 @@ def render(
             lines.append("history (5m)")
             lines.extend(sl)
     lines.extend(capacity_lines(capacity))
+    lines.extend(lanes_lines(lanes))
     lines.extend(alert_lines(alerts))
     return "\n".join(lines)
 
@@ -530,6 +583,11 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
         cap = _get_json(url + "/capacity")
         if cap and cap.get("error"):
             cap = None
+        # /lanes 404s (plain-text body) when no lane observatory is
+        # attached; _get_json returns None and the panel vanishes
+        lanes = _get_json(url + "/lanes")
+        if lanes and lanes.get("error"):
+            lanes = None
         now = time.monotonic()
         dt = (now - prev_t) if prev_t is not None else None
         if as_json:
@@ -545,9 +603,15 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
                     "time_to_breach_s": ((cap or {}).get("forecast")
                                          or {}).get("time_to_breach_s"),
                 } if cap else None,
+                "lane_advice": {
+                    fam: row.get("advice")
+                    for fam, row in (lanes.get("scoreboard") or {}).items()
+                } if lanes else None,
             }, default=str))
         else:
-            out = render(snap, health, slo, prev, dt, queries, alerts, cap)
+            out = render(
+                snap, health, slo, prev, dt, queries, alerts, cap, lanes
+            )
             if not once:
                 print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
             print(out, flush=True)
@@ -813,6 +877,56 @@ def self_check() -> int:
         "render appends capacity panel only when a report is passed",
         "capacity" in render(snap, capacity=cap_report)
         and "capacity" not in render(snap),
+    )
+
+    # lanes panel: scoreboard rows + outcome totals; no panel when the
+    # observatory is off (the /lanes report dict is empty then)
+    lane_report = {
+        "decisions": 42,
+        "probes_run": 12,
+        "pending_probes": 1,
+        "outcomes": {"chosen_best": 9, "regret": 3},
+        "scoreboard": {
+            "fam-aaaa": {
+                "lanes": {
+                    "dense": {"probes": 12, "wins": 9, "win_ratio": 0.75,
+                              "wall_p95": 0.004},
+                    "pdhg": {"probes": 12, "wins": 3, "win_ratio": 0.25,
+                             "wall_p95": 0.009},
+                },
+                "advice": "dense",
+                "forced": None,
+            },
+        },
+    }
+    ll = lanes_lines(lane_report)
+    check(
+        "lanes panel: per-family scoreboard row with advice",
+        any("fam-aaaa" in x and "dense 9/12 win=0.75 p95=4.0ms" in x
+            and "advice=dense" in x for x in ll),
+        str(ll),
+    )
+    check(
+        "lanes panel: totals line flags regret",
+        any("decisions=42" in x and "probes=12" in x and "REGRET=3" in x
+            and "pending=1" in x for x in ll),
+        str(ll),
+    )
+    forced = json.loads(json.dumps(lane_report))
+    forced["scoreboard"]["fam-aaaa"]["forced"] = "dense"
+    check(
+        "lanes panel: forced advice marked",
+        any("advice=dense (forced)" in x for x in lanes_lines(forced)),
+        str(lanes_lines(forced)),
+    )
+    check(
+        "lanes panel absent when observatory off",
+        lanes_lines(None) == [] and lanes_lines({}) == [],
+    )
+    check(
+        "render appends lanes panel only when a report is passed",
+        "lanes" in render(snap, lanes=lane_report)
+        and "lanes" not in render(snap),
     )
 
     # qps from a counter delta between two polls

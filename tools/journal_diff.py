@@ -83,6 +83,15 @@ _HIGHER_IS_BETTER = (
     # fleet_desired_shards falls through too: the same workload needing
     # more shards is an efficiency regression.
     "headroom", "knee_rate", "time_to_breach",
+    # lane observatory (obs/lanes.py): a (family, lane) win ratio
+    # dropping means the routed lane stopped winning its shadow probes —
+    # the good direction is up. lane_regret_seconds p95s, the
+    # outcome="regret" probe counters, and lane_probe_wall_seconds_total
+    # all fall through to lower-is-better (regret growing or the probes
+    # themselves getting pricier is the bad direction), and route_advice
+    # never enters the surface at all — lane codes are nominal, not
+    # ordinal.
+    "lane_win_ratio",
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
@@ -162,6 +171,15 @@ _ZERO_SEEDED = (
     "capacity_littles_law_residual", "capacity_utilization_law_residual",
     "capacity_model_error_ratio", "capacity_headroom_ratio",
     "capacity_knee_rate_per_sec", "fleet_desired_shards",
+    # lane observatory (obs/lanes.py): regret outcomes only exist once a
+    # shadow probe measured the alternate lane beating the routed one —
+    # a clean baseline has no such series, so mispredicted routes
+    # appearing in NEW gate from zero. The chosen_best/total probe
+    # volume counters and lane_decisions_total are deliberately NOT
+    # here: like perf_* and telemetry frames they exist only when the
+    # opt-in observatory is attached, so a probe-on run against a
+    # probe-off baseline must not trip the gate.
+    'outcome="regret"',
 )
 
 
@@ -362,10 +380,14 @@ def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
                     # solve_residual_* (obs/conformance.py) diff as p95s
                     # too: a residual distribution shifting up is an
                     # accuracy regression
+                    # lane_regret_seconds (obs/lanes.py) diffs as a p95
+                    # too: routing regret creeping up is a latency left
+                    # on the table even when every primary wall held
                     if (series.startswith("serve_")
                             or series.startswith("compile_seconds")
                             or series.startswith("perf_")
-                            or series.startswith("solve_residual_")):
+                            or series.startswith("solve_residual_")
+                            or series.startswith("lane_regret_seconds")):
                         p = _hist_p95(h)
                         if p is not None:
                             out[f"metric/{series}/p95"] = p
@@ -376,10 +398,15 @@ def metrics_from_journal(records: List[dict]) -> Dict[str, float]:
                     # the capacity observatory's close gauges (law
                     # residuals, headroom, knee, model error, the shard
                     # recommendation) are the validated-autoscale surface
+                    # lane_win_ratio gauges join the surface too (a
+                    # routed lane that stopped winning its probes is a
+                    # routing regression); route_advice stays out — its
+                    # lane codes are nominal labels, not a quality axis
                     if _is_num(v) and (
                         series.startswith("alerts_firing")
                         or series.startswith("capacity_")
                         or series.startswith("fleet_desired_shards")
+                        or series.startswith("lane_win_ratio")
                         or "_p9" in series or "_p50" in series
                     ):
                         out[f"metric/{series}"] = float(v)
@@ -1114,6 +1141,99 @@ def self_check(out=sys.stdout) -> int:
         "headroom + knee alone appearing vs clean baseline pass "
         "(higher-is-better never gates on growth)",
         False, any(r["regression"] for r in rows)))
+
+    # lane observatory (obs/lanes.py): regret outcomes and regret p95s
+    # gate lower-is-better (regret appearing or growing = mispredicted
+    # routes), win ratios gate on a same-workload drop, probe volume
+    # never gates an observatory-on run against an off baseline, and
+    # route_advice codes stay out of the surface entirely
+    lbase = {
+        'metric/lane_shadow_probes_total{family="abc123",outcome="chosen_best"}':
+        20.0,
+        'metric/lane_shadow_probes_total{family="abc123",outcome="regret"}':
+        0.0,
+        'metric/lane_regret_seconds{family="abc123"}/p95': 0.001,
+        'metric/lane_win_ratio{family="abc123",lane="dense"}': 0.9,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def lrun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(lbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    lrun("identical lane metrics pass", dict(lbase), False)
+    lrun("regret outcomes appearing from zero fail (mispredicted routes)",
+         {**lbase,
+          'metric/lane_shadow_probes_total{family="abc123",outcome="regret"}':
+          3.0}, True)
+    lrun("regret count tripling fails (lower is better)",
+         {**{**lbase,
+             'metric/lane_shadow_probes_total{family="abc123",outcome="regret"}':
+             6.0}}, True)
+    lrun("lane regret p95 regression >10% fails (latency left on the table)",
+         {**lbase,
+          'metric/lane_regret_seconds{family="abc123"}/p95': 0.005}, True)
+    lrun("lane regret p95 improving passes",
+         {**lbase,
+          'metric/lane_regret_seconds{family="abc123"}/p95': 0.0002}, False)
+    lrun("win ratio dropping >10% fails (routed lane stopped winning)",
+         {**lbase,
+          'metric/lane_win_ratio{family="abc123",lane="dense"}': 0.5}, True)
+    lrun("win ratio growing passes (higher is better)",
+         {**lbase,
+          'metric/lane_win_ratio{family="abc123",lane="dense"}': 1.0}, False)
+    cleanl = {"serve/loadgen/goodput_rps": 120.0}
+    rows = compare(cleanl, {
+        **cleanl,
+        'metric/lane_shadow_probes_total{family="abc123",outcome="chosen_best"}':
+        20.0,
+        'metric/lane_decisions_total{entry="serve",lane="dense"}': 200.0,
+    })
+    checks.append((
+        "observatory-on run vs observatory-off baseline passes "
+        "(probe/decision volume counters are not zero-seeded)",
+        False, any(r["regression"] for r in rows)))
+    rows = compare(cleanl, {
+        **cleanl,
+        'metric/lane_shadow_probes_total{family="abc123",outcome="regret"}':
+        2.0,
+    })
+    checks.append((
+        "regret appearing vs observatory-off baseline still fails "
+        "(zero-seeded evidence of mispredicted routes)",
+        True, any(r["regression"] for r in rows)))
+    # extraction: the close snapshot's lane histograms/gauges enter the
+    # comparable surface (p95 for regret, raw value for win ratios)
+    lane_close = [
+        {"kind": "manifest", "run_id": "r1"},
+        {"kind": "close", "retrace_totals": {}, "metrics": {
+            "counters": {
+                'lane_decisions_total{entry="serve",lane="dense"}': 9.0,
+            },
+            "histograms": {
+                'lane_regret_seconds{family="abc123"}': {
+                    "count": 4, "sum": 0.01,
+                    "buckets": {"0.001": 2, "0.005": 2, "+Inf": 0},
+                },
+            },
+            "gauges": {
+                'lane_win_ratio{family="abc123",lane="dense"}': 0.75,
+                'route_advice{family="abc123"}': 1.0,
+            },
+        }},
+    ]
+    table = metrics_from_journal(lane_close)
+    checks.append((
+        "lane_regret_seconds p95 extracted from the close snapshot",
+        True,
+        _is_num(table.get('metric/lane_regret_seconds{family="abc123"}/p95'))
+        and table['metric/lane_regret_seconds{family="abc123"}/p95'] > 0.0))
+    checks.append((
+        "lane_win_ratio gauge extracted, route_advice code kept out",
+        True,
+        table.get('metric/lane_win_ratio{family="abc123",lane="dense"}')
+        == 0.75
+        and 'metric/route_advice{family="abc123"}' not in table))
 
     ok = True
     for name, want, got in checks:

@@ -188,6 +188,17 @@ def main(argv=None, out=sys.stdout) -> int:
                     help="--shards mode: goldens .npz (from "
                     "tools/canary_report.py --certify) injected through "
                     "the full router->shard path on a cadence")
+    ap.add_argument("--lanes", action="store_true",
+                    help="attach the lane observatory: journal every "
+                    "routing decision, shadow-probe a sampled fraction "
+                    "on the alternate IPM<->PDHG lane, and serve the "
+                    "per-family scoreboards at the exporter's /lanes — "
+                    "docs/observability.md §14")
+    ap.add_argument("--lane-policy", default=None, choices=["advice"],
+                    help="--shards mode, with --lanes: let the router "
+                    "consult the observatory's damped route_advice "
+                    "(default off; observation alone never changes "
+                    "routing)")
     args = ap.parse_args(argv)
 
     import jax
@@ -248,6 +259,8 @@ def main(argv=None, out=sys.stdout) -> int:
                                 timeseries=args.timeseries,
                                 conformance=args.conformance or None,
                                 canary=args.canary,
+                                lanes=args.lanes or None,
+                                lane_policy=args.lane_policy,
                                 solver_kw={"max_iter": args.max_iter},
                             )
                         else:
@@ -260,6 +273,7 @@ def main(argv=None, out=sys.stdout) -> int:
                                 warm_model=args.warm_model,
                                 timeseries=args.timeseries,
                                 conformance=args.conformance or None,
+                                lanes=args.lanes or None,
                             )
                         svc.start()
                         if exporter is not None and args.timeseries:
@@ -271,6 +285,10 @@ def main(argv=None, out=sys.stdout) -> int:
                         if exporter is not None and args.conformance:
                             exporter.conformance_fn = getattr(
                                 svc, "conformance_report", None
+                            )
+                        if exporter is not None and args.lanes:
+                            exporter.lanes_fn = getattr(
+                                svc, "lane_report", None
                             )
                     kw = {}
                     if args.shards > 0:

@@ -35,6 +35,12 @@ manifest record). For each run this prints:
   worst residuals per entry), and a canary ledger from ``canary``
   events (per-outcome counts plus any mismatched goldens) — pre-v5
   journals and plane-off runs render exactly as before;
+- when the run holds schema-v6 lane records (an `obs.lanes`
+  observatory was attached), a ``lane=`` column on solve lines that
+  carry the chosen-lane attr and a lanes footer: per-family lane
+  shares from ``lane_decision`` events plus shadow-probe outcome and
+  regret counts from ``lane_probe`` events — pre-v6 journals and
+  plane-off runs render exactly as before;
 - cumulative retrace counts from the close record (or summed span deltas
   for a run that died before closing).
 
@@ -304,6 +310,11 @@ def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
             line += f" remedied={n_rec}/{len(ad['remediated'])}"
     elif ev.get("adaptive"):
         line += " adaptive"
+    # schema-v6 chosen-lane attr (obs/lanes.py): which solver family
+    # took the solve. Journals predating the observatory render exactly
+    # as before.
+    if ev.get("lane"):
+        line += f" lane={ev['lane']}"
     # serve-layer columns (dispatches_tpu/serve): per-request solves
     if ev.get("request_id") is not None:
         line += f" req={ev['request_id']}"
@@ -370,6 +381,8 @@ def _print_health_footer(run: List[dict], out) -> None:
                 continue  # echoes a verdict already counted at its solve
             if ev.get("name") == "canary":
                 continue  # probe verdicts land in the conformance footer
+            if ev.get("name") in ("lane_decision", "lane_probe"):
+                continue  # echo a solve's verdict; counted in the lanes footer
             v = None
             if ev.get("name") == "hang":
                 v = "hang"
@@ -485,6 +498,51 @@ def _print_conformance_footer(run: List[dict], out) -> None:
             + (f" rel_x={rx:.1e}" if rx is not None else ""),
             file=out,
         )
+
+
+def _print_lanes_footer(run: List[dict], out) -> None:
+    """Per-family lane shares from schema-v6 ``lane_decision`` events,
+    plus the shadow-probe ledger from ``lane_probe`` events (outcome
+    counts and summed regret per family). Silent for pre-v6 journals
+    and observatory-off runs — no events, no footer."""
+    fam_lanes: dict = {}
+    probes: dict = {}
+    for ev in run:
+        if ev.get("kind") != "event":
+            continue
+        if ev.get("name") == "lane_decision":
+            fam = str(ev.get("family") or "?")
+            per = fam_lanes.setdefault(fam, {})
+            lane = str(ev.get("lane") or "?")
+            per[lane] = per.get(lane, 0) + 1
+        elif ev.get("name") == "lane_probe":
+            fam = str(ev.get("family") or "?")
+            d = probes.setdefault(fam, {"outcomes": {}, "regret_s": 0.0})
+            o = str(ev.get("outcome") or "?")
+            d["outcomes"][o] = d["outcomes"].get(o, 0) + 1
+            if o == "regret" and isinstance(
+                ev.get("regret_s"), (int, float)
+            ):
+                d["regret_s"] += float(ev["regret_s"])
+    if not fam_lanes and not probes:
+        return
+    for fam in sorted(set(fam_lanes) | set(probes)):
+        per = fam_lanes.get(fam, {})
+        total = sum(per.values())
+        bits = [
+            f"{lane}={n}({100.0 * n / total:.0f}%)"
+            for lane, n in sorted(per.items())
+        ] if total else []
+        d = probes.get(fam)
+        if d:
+            outc = ",".join(
+                f"{k}={v}" for k, v in sorted(d["outcomes"].items())
+            )
+            probe_txt = f"probes[{outc}]"
+            if d["regret_s"]:
+                probe_txt += f" regret={d['regret_s']:.4f}s"
+            bits.append(probe_txt)
+        print(f"  lanes {fam[:12]}: {' '.join(bits)}", file=out)
 
 
 def _print_journeys_footer(run: List[dict], out) -> None:
@@ -686,6 +744,7 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
     _print_solves(run, out)
     _print_health_footer(run, out)
     _print_conformance_footer(run, out)
+    _print_lanes_footer(run, out)
     _print_warm_footer(run, out)
     _print_journeys_footer(run, out)
     _print_compile_footer(run, out)
