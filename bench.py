@@ -1990,6 +1990,145 @@ def main():
         **{k: v for k, v in ln.items() if k != "report"},
     )
 
+    # PDLP-vs-IPM head-to-head: the lane router's "PDHG wins on merit"
+    # claim, measured instead of asserted. Two families the router
+    # actually arbitrates: the weekly price-taker block (the year
+    # solve's building block, where first-order methods earn their
+    # keep) and a NETWORK-style single-hour DC-OPF (synthesize_network's
+    # meshed grid — the per-hour LP behind the NETWORK_YEAR rows). Each
+    # is solved on three lanes — dense IPM, historical PDHG, and PDHG
+    # with the PDLP controls on (adaptive restarts + primal-weight +
+    # line search) — recording iterations, warm wall, and final
+    # original-frame residuals per lane. The gate is the perf claim
+    # itself: on the year-scale family the controls must converge in no
+    # more iterations than historical PDHG (accelerator runs only;
+    # off-record runs exercise the plumbing). The row rides the
+    # benchstore history append below under stable family-keyed paths
+    # (rows/pdlp_vs_ipm/<family>/<lane>/iterations), so the claim is
+    # trend-gated run over run, not anecdotal.
+    def _pdlp_row():
+        from dispatches_tpu.market.network import (
+            dcopf_program,
+            synthesize_network,
+        )
+        from dispatches_tpu.solvers.pdhg import solve_lp_pdhg
+
+        pdlp_kw = dict(
+            adaptive_restarts=True, primal_weight=True, linesearch=True
+        )
+        ptol = 1e-6
+        # CPU smoke: the weekly family's PDLP lane lands ~19.4k
+        # iterations, so 30k keeps margin while still bounding a
+        # historical-PDHG stall to seconds of host matvecs
+        pmax = 30_000 if smoke else 100_000
+
+        def _lane(fn, problem, **kw):
+            sol = fn(problem, **kw)  # untimed warm-up pays the compile
+            jax.block_until_ready(sol.x)
+            t0 = time.perf_counter()
+            sol = fn(problem, **kw)
+            jax.block_until_ready(sol.x)
+            wall = time.perf_counter() - t0
+            rec = {
+                "iterations": int(np.asarray(sol.iterations)),
+                "wall_s": round(wall, 4),
+                "converged": bool(np.asarray(sol.converged)),
+                "obj": float(np.asarray(sol.obj)),
+                "res_primal": float(np.asarray(sol.res_primal)),
+                "res_dual": float(np.asarray(sol.res_dual)),
+            }
+            if hasattr(sol, "restarts"):
+                rec["restarts"] = int(np.asarray(sol.restarts))
+            return rec
+
+        def _family(dense_lp, sparse_lp):
+            # the IPM lane is the objective-agreement REFERENCE, so it
+            # needs tighter-than-bench tolerance: on the near-zero-cost
+            # network hour, 1e-6 stops one iteration early at obj 0.105
+            # where the optimum is ~5e-5 — an absolute error larger
+            # than the agreement band. 1e-8 costs a single extra
+            # Mehrotra step on every family measured.
+            ipm = _lane(
+                solve_lp, dense_lp, tol=min(tol, 1e-8), max_iter=60)
+            base = _lane(
+                solve_lp_pdhg, sparse_lp, tol=ptol, max_iter=pmax)
+            ctl = _lane(
+                solve_lp_pdhg, sparse_lp, tol=ptol, max_iter=pmax,
+                **pdlp_kw)
+            # objective agreement is only meaningful for lanes that
+            # report convergence — a maxed-out historical PDHG is the
+            # comparison's SUBJECT, not a correctness failure
+            sc = 1.0 + abs(ipm["obj"])
+            agree = all(
+                abs(lane["obj"] - ipm["obj"]) <= 1e-4 * sc
+                for lane in (base, ctl)
+                if lane["converged"]
+            )
+            return {
+                "ipm": ipm,
+                "pdhg": base,
+                "pdlp": ctl,
+                "obj_agree": bool(agree),
+            }
+
+        wk_params = {
+            "lmp": jnp.asarray(lmp_weeks[0], jnp.float64),
+            "wind_cf": jnp.asarray(cf_weeks[0], jnp.float64),
+        }
+        fams = {
+            "year_scale_weekly": _family(
+                prog.instantiate(wk_params, dtype=jnp.float64),
+                prog.instantiate_coo(wk_params, dtype=jnp.float64),
+            )
+        }
+        grid = synthesize_network(
+            n_buses=10 if smoke else 30,
+            n_units=12 if smoke else 50,
+            days=1,
+            seed=17,
+        )
+        nprog = dcopf_program(grid)
+        h = 12  # midday: load and wind both away from their bounds
+        loads = np.zeros(len(grid.buses))
+        for cb, v in zip(grid.load_bus, grid.da_load[h]):
+            loads[grid.bus_index(cb)] += v
+        nparams = {
+            "load": jnp.asarray(loads, jnp.float64),
+            "ren_cap": jnp.asarray(grid.da_renewables[h], jnp.float64),
+            "commit": jnp.ones(max(len(grid.thermal), 1), jnp.float64),
+        }
+        fams["network_dcopf"] = _family(
+            nprog.instantiate(nparams, dtype=jnp.float64),
+            nprog.instantiate_coo(nparams, dtype=jnp.float64),
+        )
+
+        yr = fams["year_scale_weekly"]
+        fewer = yr["pdlp"]["iterations"] <= yr["pdhg"]["iterations"]
+        healthy = all(
+            f["ipm"]["converged"]
+            and f["pdlp"]["converged"]
+            and f["obj_agree"]
+            for f in fams.values()
+        )
+        return {
+            **fams,
+            "pdlp_tol": ptol,
+            "pdlp_max_iter": pmax,
+            "controls": sorted(pdlp_kw),
+            "iters_saved_year": (
+                yr["pdhg"]["iterations"] - yr["pdlp"]["iterations"]),
+            "fewer_iters_ok": fewer,
+            "fewer_iters_gated": not _OFF_RECORD,
+            "gate_ok": healthy and (fewer or _OFF_RECORD),
+        }
+
+    pv = _device("pdlp_vs_ipm", _pdlp_row)
+    _LOCAL["rows"]["pdlp_vs_ipm"] = dict(pv)
+    _DIAG.setdefault("serve", {})["pdlp_vs_ipm"] = dict(pv)
+    _atomic_dump(_DIAG, _DIAG_PATH)
+    _flush_local()
+    _journal().event("row", row="pdlp_vs_ipm", **pv)
+
     result = {
         "metric": "weekly wind+battery+PEM price-taker LP solves/sec/chip "
         f"(T=168h, batch={B}, converged={conv_frac:.3f}, "
@@ -2018,6 +2157,13 @@ def main():
             "SERVE GATE FAILED (lost/unhealthy requests, or continuous "
             "batching did not beat the serial baseline on the "
             "accelerator; see rows.serve_loadgen): " + result["metric"]
+        )
+    if not pv["gate_ok"]:
+        result["metric"] = (
+            "PDLP GATE FAILED (controls-on PDHG did not converge, "
+            "disagreed with IPM, or took more iterations than the "
+            "historical lane on the year-scale family; see "
+            "rows.pdlp_vs_ipm): " + result["metric"]
         )
 
     _LOCAL["partial"] = False

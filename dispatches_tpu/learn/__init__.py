@@ -9,8 +9,13 @@ journaled solves, serve them through the safeguarded warm-start path.
 - `learn.predictor` — batch-safe online inference feeding the solvers'
   safeguarded ``warm_start=`` plumbing; bad predictions degrade to the
   cold path, never to wrong answers.
+- `learn.laneroute` — lane-portfolio model trained on the lane
+  observatory's probe-pair shards, predicting ``(best_lane,
+  expected_iterations)`` per problem; served as ``lane_policy="model"``
+  with fallback to the measured advice scoreboards.
 
-See docs/learned_warmstarts.md; the CLI is tools/train_warmstart.py.
+See docs/learned_warmstarts.md; the CLIs are tools/train_warmstart.py
+and tools/train_laneroute.py.
 """
 from .dataset import (
     DatasetWriter,
@@ -27,17 +32,31 @@ from .warmstart import (
     train_warmstart_model,
 )
 from .predictor import WarmStartPredictor
+from .laneroute import (
+    LANEROUTE_VERSION,
+    LaneRouteModel,
+    LaneRouter,
+    RoutePrediction,
+    as_laneroute,
+    train_laneroute_model,
+)
 
 __all__ = [
     "ARTIFACT_VERSION",
     "ArtifactMismatch",
     "DatasetWriter",
+    "LANEROUTE_VERSION",
+    "LaneRouteModel",
+    "LaneRouter",
+    "RoutePrediction",
     "WarmStartDataset",
     "WarmStartModel",
     "WarmStartPredictor",
+    "as_laneroute",
     "family_fingerprint",
     "features_of",
     "load_dataset",
     "targets_of",
+    "train_laneroute_model",
     "train_warmstart_model",
 ]

@@ -1,0 +1,388 @@
+"""Learned lane-portfolio routing: predict the fastest solver lane.
+
+PR 18's lane observatory (`obs/lanes.py`) measures per-family routing
+regret with shadow probes and exports labeled probe pairs —
+``X = features_of(problem)``, ``Y = [wall_dense, wall_pdhg, iters_dense,
+iters_pdhg, chosen]`` — as `learn.dataset`-format shards. This module
+closes the loop: train a small portfolio model on those shards that
+predicts per-lane wall time and iteration count from the schema-v6
+feature vector, and serve it as ``lane_policy="model"``
+(`runtime/adaptive.py`, `serve/fleet.py`).
+
+The plumbing deliberately mirrors `learn.warmstart`:
+
+- the training loop is `surrogates.train.train_surrogate` (same MLP,
+  same Adam/MSE full-batch loop);
+- the artifact is a single ``.npz`` with ``__manifest__`` JSON +
+  ``scale/<k>`` + ``w/<path>`` keys, versioned, refusing to load on a
+  version/kind/family mismatch (`ArtifactMismatch` — a structurally
+  wrong artifact is an operator error, never a silent cold path);
+- serving-side inference (`LaneRouter`) never raises and never gates
+  correctness: an unseen family or a feature-shape mismatch falls back
+  to the observatory's measured ``advice`` scoreboards, counted under
+  ``lane_model_fallback_total``. Mispredictions surface through the
+  existing shadow-probe machinery as
+  ``lane_shadow_probes_total{outcome="regret"}`` — routed solves still
+  flow through `LaneObservatory.note_solve`, so the model is audited by
+  the same measurement plane that trained it.
+
+The predicted iteration count rides along (``RoutePrediction.iterations``,
+journaled on the ``lane_decision`` event) as the batch-packing signal for
+ROADMAP item 4.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dataset import WarmStartDataset
+from .warmstart import ArtifactMismatch, _unflatten
+
+LANEROUTE_VERSION = 1
+LANEROUTE_KIND = "laneroute"
+
+# Column order of the lane-observatory probe-pair shards
+# (obs.lanes.LaneObservatory.export_dataset); the model trains on the
+# first four, "chosen" is the historical route, not ground truth.
+PROBE_TARGETS = (
+    ("wall_dense", 1), ("wall_pdhg", 1),
+    ("iters_dense", 1), ("iters_pdhg", 1), ("chosen", 1),
+)
+ROUTE_LANES = ("dense", "pdhg")
+
+_SCALE_KEYS = ("xm_inputs", "xstd_inputs", "xmin", "xmax", "y_mean", "y_std")
+
+from ..obs import metrics as obs_metrics
+
+obs_metrics.describe(
+    "lane_model_route_total",
+    "solves routed by the learned lane-portfolio model, by predicted lane",
+)
+obs_metrics.describe(
+    "lane_model_fallback_total",
+    "lane-model consultations that fell back to the observatory's "
+    "advice scoreboards (unseen family, feature mismatch, or prediction "
+    "error) — the model never gates correctness",
+)
+
+
+class RoutePrediction(Tuple):
+    """``(lane, iterations)`` with named access."""
+
+    __slots__ = ()
+
+    def __new__(cls, lane: str, iterations: float):
+        return tuple.__new__(cls, (lane, float(iterations)))
+
+    @property
+    def lane(self) -> str:
+        return self[0]
+
+    @property
+    def iterations(self) -> float:
+        return self[1]
+
+
+class LaneRouteModel:
+    """A trained per-family lane-portfolio predictor plus its manifest.
+
+    ``manifest`` keys: ``version``, ``kind`` (= "laneroute"),
+    ``family``, ``problem_type``, ``varying``, ``targets`` (the
+    four-column wall/iters layout), ``feature_dim``, ``target_dim``,
+    ``hidden``, ``train_best_lane`` (majority measured winner over the
+    training pairs — the family-level advice a fleet router consumes
+    when it only knows the family, not the instance), ``lane_share``
+    (that winner's share of training rows), and ``metrics``."""
+
+    def __init__(self, surrogate, manifest: Dict):
+        self.surrogate = surrogate
+        self.manifest = dict(manifest)
+
+    # -- manifest accessors -------------------------------------------
+    @property
+    def family(self) -> str:
+        return self.manifest["family"]
+
+    @property
+    def varying(self) -> Tuple[str, ...]:
+        return tuple(self.manifest["varying"])
+
+    @property
+    def feature_dim(self) -> int:
+        return int(self.manifest["feature_dim"])
+
+    @property
+    def train_best_lane(self) -> str:
+        return str(self.manifest["train_best_lane"])
+
+    # -- inference -----------------------------------------------------
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """(batch, feature_dim) -> (batch, 4) predicted
+        ``[wall_dense, wall_pdhg, iters_dense, iters_pdhg]``."""
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2 or X.shape[1] != self.feature_dim:
+            raise ValueError(
+                f"feature shape {X.shape} does not match artifact "
+                f"feature_dim={self.feature_dim}"
+            )
+        out = np.asarray(self.surrogate.predict(X), np.float64)
+        return out.reshape(X.shape[0], -1)
+
+    def route(self, X: np.ndarray) -> List[RoutePrediction]:
+        """Per-row ``RoutePrediction``: the lane with the smaller
+        predicted wall, and that lane's predicted iteration count
+        (clamped to >= 1)."""
+        pred = self.predict(X)
+        out: List[RoutePrediction] = []
+        for row in pred:
+            k = int(np.argmin(row[:2]))
+            out.append(RoutePrediction(
+                ROUTE_LANES[k], max(1.0, float(row[2 + k]))
+            ))
+        return out
+
+    # -- persistence (the warmstart artifact layout) -------------------
+    def save(self, path: str) -> str:
+        import jax
+
+        flat = jax.tree_util.tree_flatten_with_path(self.surrogate.params)[0]
+        payload = {
+            "w/" + "/".join(str(p) for p in kp): np.asarray(v)
+            for kp, v in flat
+        }
+        for k in _SCALE_KEYS:
+            payload[f"scale/{k}"] = np.asarray(self.surrogate.scaling[k])
+        payload["__manifest__"] = np.asarray(json.dumps(self.manifest))
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        np.savez(path, **payload)
+        return path
+
+    @classmethod
+    def load(cls, path: str,
+             expect_family: Optional[str] = None) -> "LaneRouteModel":
+        """Reload an artifact; raises `ArtifactMismatch` on an unknown
+        version, a non-laneroute kind, or a family disagreement."""
+        from ..surrogates.train import SurrogateMLP, TrainedSurrogate
+
+        with np.load(path, allow_pickle=False) as dat:
+            if "__manifest__" not in dat.files:
+                raise ArtifactMismatch(f"{path}: not a lane-route artifact")
+            manifest = json.loads(str(dat["__manifest__"]))
+            weights = {
+                k[2:]: np.asarray(dat[k])
+                for k in dat.files if k.startswith("w/")
+            }
+            scaling = {
+                k.split("/", 1)[1]: np.asarray(dat[k])
+                for k in dat.files if k.startswith("scale/")
+            }
+        if manifest.get("kind") != LANEROUTE_KIND:
+            raise ArtifactMismatch(
+                f"{path}: artifact kind {manifest.get('kind')!r}, "
+                f"expected {LANEROUTE_KIND!r}"
+            )
+        ver = manifest.get("version")
+        if ver != LANEROUTE_VERSION:
+            raise ArtifactMismatch(
+                f"{path}: artifact version {ver!r}, this build reads "
+                f"{LANEROUTE_VERSION}"
+            )
+        if expect_family is not None and manifest.get("family") != expect_family:
+            raise ArtifactMismatch(
+                f"{path}: trained for family "
+                f"{manifest.get('family')!r:.24}..., caller is serving "
+                f"family {expect_family!r:.24}..."
+            )
+        missing = [k for k in _SCALE_KEYS if k not in scaling]
+        if missing or not weights:
+            raise ArtifactMismatch(
+                f"{path}: artifact missing {missing or ['weights']}"
+            )
+        params = _unflatten(weights)
+        model = SurrogateMLP(
+            hidden=tuple(manifest["hidden"]),
+            out_dim=int(manifest["target_dim"]),
+        )
+        scl = {k: v.tolist() for k, v in scaling.items()}
+        return cls(TrainedSurrogate(model, params, scl), manifest)
+
+
+def _route_accuracy(pred: np.ndarray, truth: np.ndarray) -> float:
+    """Share of rows where the predicted-fastest lane matches the
+    measured-fastest lane (columns 0/1 = wall_dense/wall_pdhg)."""
+    return float(np.mean(
+        np.argmin(pred[:, :2], axis=1) == np.argmin(truth[:, :2], axis=1)
+    ))
+
+
+def train_laneroute_model(
+    dataset: WarmStartDataset,
+    *,
+    hidden: Sequence[int] = (32, 32),
+    epochs: int = 300,
+    lr: float = 1e-3,
+    seed: int = 0,
+    holdout_frac: float = 0.2,
+    verbose: bool = False,
+) -> Tuple[LaneRouteModel, Dict]:
+    """Train one per-family portfolio model from a lane-probe dataset
+    (`obs.lanes.export_dataset` shards loaded through
+    `learn.dataset.load_dataset`). Trains on the four wall/iters columns;
+    metrics report holdout MSE plus ``route_accuracy`` (predicted-fastest
+    vs measured-fastest lane). Returns ``(model, metrics)``."""
+    from ..surrogates.train import train_surrogate
+
+    want = [[n, d] for n, d in PROBE_TARGETS]
+    got = [[str(n), int(d)] for n, d in dataset.targets]
+    if got != want:
+        raise ValueError(
+            f"not a lane-probe dataset: targets {got} != {want}"
+        )
+    Y4 = np.asarray(dataset.Y, np.float64)[:, :4]
+    ds4 = WarmStartDataset(
+        dataset.X, Y4, family=dataset.family, varying=dataset.varying,
+        targets=list(PROBE_TARGETS[:4]), problem_type=dataset.problem_type,
+        iters=dataset.iters, sources=dataset.sources,
+        skipped=dataset.skipped,
+    )
+    train, hold = ds4.split(holdout_frac=holdout_frac, seed=seed)
+    sur, train_metrics = train_surrogate(
+        train.X, train.Y, hidden=tuple(hidden), epochs=epochs, lr=lr,
+        seed=seed, verbose=verbose,
+    )
+    metrics: Dict = {
+        "rows_train": len(train),
+        "rows_holdout": len(hold),
+        "train_R2_mean": float(np.mean(np.asarray(train_metrics["R2"]))),
+        "train_route_accuracy": _route_accuracy(
+            np.asarray(sur.predict(train.X), np.float64), train.Y
+        ),
+    }
+    if len(hold):
+        pred = np.asarray(sur.predict(hold.X), np.float64)
+        err = pred - hold.Y
+        metrics["holdout_mse"] = float(np.mean(err**2))
+        metrics["route_accuracy"] = _route_accuracy(pred, hold.Y)
+    wins = np.argmin(Y4[:, :2], axis=1)
+    best = int(np.bincount(wins, minlength=2).argmax())
+    manifest = {
+        "version": LANEROUTE_VERSION,
+        "kind": LANEROUTE_KIND,
+        "family": dataset.family,
+        "problem_type": dataset.problem_type,
+        "varying": list(dataset.varying),
+        "targets": [[n, d] for n, d in PROBE_TARGETS[:4]],
+        "feature_dim": int(dataset.X.shape[1]),
+        "target_dim": 4,
+        "hidden": list(int(h) for h in hidden),
+        "train_best_lane": ROUTE_LANES[best],
+        "lane_share": float(np.mean(wins == best)),
+        "metrics": metrics,
+    }
+    return LaneRouteModel(sur, manifest), metrics
+
+
+class LaneRouter:
+    """Serving-side lane-model registry: family fingerprint ->
+    `LaneRouteModel`, with an optional ``fallback`` (family -> lane, the
+    lane observatory's ``advice``) consulted when the model has nothing.
+
+    ``route`` and ``advice`` NEVER raise — a broken router must not kill
+    the solve it was routing; failures degrade to the fallback (counted
+    under ``lane_model_fallback_total``) or to None (native lane).
+    Construction from explicit artifact paths, by contrast, raises
+    `ArtifactMismatch` loudly: pointing a fleet at a wrong artifact is an
+    operator error."""
+
+    def __init__(self, models: Iterable[LaneRouteModel] = (),
+                 fallback: Optional[Callable[[str], Optional[str]]] = None):
+        self._models: Dict[str, LaneRouteModel] = {}
+        for m in models:
+            self._models[m.family] = m
+        self.fallback = fallback
+        # zero-seed so rate alerts see a flat baseline, not an absent
+        # series (the lane-observatory counter idiom)
+        for lane in ROUTE_LANES:
+            obs_metrics.inc("lane_model_route_total", 0, lane=lane)
+        for reason in ("unseen_family", "feature_mismatch", "error"):
+            obs_metrics.inc("lane_model_fallback_total", 0, reason=reason)
+
+    @classmethod
+    def from_paths(cls, paths, fallback=None) -> "LaneRouter":
+        if isinstance(paths, (str, bytes)):
+            paths = [paths]
+        return cls(
+            (LaneRouteModel.load(str(p)) for p in paths),
+            fallback=fallback,
+        )
+
+    @property
+    def families(self) -> Tuple[str, ...]:
+        return tuple(self._models)
+
+    def model_for(self, family: str) -> Optional[LaneRouteModel]:
+        return self._models.get(family)
+
+    def route(self, problem) -> Optional[RoutePrediction]:
+        """Per-instance prediction for a problem row, or None when the
+        caller should use its fallback/native path."""
+        try:
+            from .dataset import family_fingerprint, features_of
+
+            family = family_fingerprint(problem)
+            model = self._models.get(family)
+            if model is None:
+                obs_metrics.inc(
+                    "lane_model_fallback_total", reason="unseen_family"
+                )
+                return None
+            feats = features_of(problem, varying=model.varying)
+            if feats.size != model.feature_dim:
+                obs_metrics.inc(
+                    "lane_model_fallback_total", reason="feature_mismatch"
+                )
+                return None
+            pred = model.route(feats[None])[0]
+            obs_metrics.inc("lane_model_route_total", lane=pred.lane)
+            return pred
+        except Exception:
+            obs_metrics.inc("lane_model_fallback_total", reason="error")
+            return None
+
+    def advice(self, family: Optional[str]) -> Optional[str]:
+        """Family-level advised lane for fleet routing (the
+        ``Router.advice_fn`` shape): the model's majority measured winner
+        when the family is known, else the fallback scoreboard."""
+        try:
+            if family is not None:
+                model = self._models.get(family)
+                if model is not None:
+                    lane = model.train_best_lane
+                    obs_metrics.inc("lane_model_route_total", lane=lane)
+                    return lane
+                obs_metrics.inc(
+                    "lane_model_fallback_total", reason="unseen_family"
+                )
+            if self.fallback is not None:
+                return self.fallback(family)
+            return None
+        except Exception:
+            obs_metrics.inc("lane_model_fallback_total", reason="error")
+            return None
+
+
+def as_laneroute(arg, fallback=None) -> Optional[LaneRouter]:
+    """Coerce a ``lane_model=`` argument: None passes through, a
+    `LaneRouter` is returned as-is (its fallback updated if unset), a
+    path or sequence of paths loads artifacts (raising `ArtifactMismatch`
+    on structurally wrong ones)."""
+    if arg is None:
+        return None
+    if isinstance(arg, LaneRouter):
+        if arg.fallback is None and fallback is not None:
+            arg.fallback = fallback
+        return arg
+    return LaneRouter.from_paths(arg, fallback=fallback)

@@ -272,23 +272,30 @@ class LaneObservatory:
         iterations: Optional[int] = None,
         verdict: str = "healthy",
         journal: bool = True,
+        predicted_iterations: Optional[float] = None,
     ) -> Optional[Dict[str, Any]]:
         """Record one completed solve's routing decision. Observational
         only — reads the problem, journals a schema-v6 ``lane_decision``
         event, bumps counters, and maybe enqueues a shadow probe. Never
         raises (a broken observatory must not kill the solve it
-        observed). Returns the journaled attrs dict, or None when the
-        problem has no lane."""
+        observed). ``predicted_iterations`` is the lane-portfolio
+        model's expected iteration count when ``lane_policy="model"``
+        routed this solve (the item-4 batch-packing signal) — journaled
+        alongside the measured count so mispredictions are auditable.
+        Returns the journaled attrs dict, or None when the problem has
+        no lane."""
         try:
             return self._note_solve(
                 problem, lane, entry=entry, wall=wall,
                 iterations=iterations, verdict=verdict, journal=journal,
+                predicted_iterations=predicted_iterations,
             )
         except Exception:
             return None
 
     def _note_solve(self, problem, lane, *, entry, wall, iterations,
-                    verdict, journal) -> Optional[Dict[str, Any]]:
+                    verdict, journal,
+                    predicted_iterations=None) -> Optional[Dict[str, Any]]:
         from ..learn.dataset import family_fingerprint, features_of
 
         lane = lane or lane_of(problem)
@@ -313,6 +320,8 @@ class LaneObservatory:
             attrs["wall_s"] = float(wall)
         if iterations is not None:
             attrs["iterations"] = int(iterations)
+        if predicted_iterations is not None:
+            attrs["predicted_iterations"] = float(predicted_iterations)
         if journal:
             get_tracer().event("lane_decision", **attrs)
         with self._lock:

@@ -87,7 +87,12 @@ def flag_divergent(tr: SolveTrace, blowup: float = 1e3) -> np.ndarray:
         if len(fin) < len(g):  # non-finite inside the recorded region
             out[b] = True
             continue
-        if len(fin) and fin[-1] > blowup * max(fin.min(), 1e-300):
+        # the blowup reference is the smallest POSITIVE gap seen: an
+        # exact-zero entry (a PDLP restart can momentarily equalize the
+        # primal and dual objectives) is a degenerate floor that would
+        # flag any converged-but-nonzero ending as a 1e3x blowup
+        pos = fin[fin > 0.0]
+        if len(pos) and fin[-1] > blowup * pos.min():
             out[b] = True
     return out if gap.ndim > 1 else out[0]
 
@@ -107,7 +112,7 @@ def trace_stats(tr: SolveTrace) -> dict:
         fin_rp.append(float(rp[b, k]) if rp.shape[1] else float("nan"))
         fin_rd.append(float(rd[b, k]) if rd.shape[1] else float("nan"))
     div = np.atleast_1d(flag_divergent(tr))
-    return {
+    out = {
         "batch": int(B),
         "recorded_iterations": [int(v) for v in n_rec],
         "final_gap": fin_gap,
@@ -116,3 +121,30 @@ def trace_stats(tr: SolveTrace) -> dict:
         "divergent": [bool(v) for v in div],
         "n_divergent": int(div.sum()),
     }
+    # step-size trajectory summary: first/final primal step plus the
+    # number of recorded step CHANGES per trajectory. A constant-step
+    # solve (historical PDHG, IPM's fraction-to-boundary jitter aside)
+    # shows changes=0; a Malitsky–Pock line search or an adaptive
+    # primal-weight rebalance shows its activity here without shipping
+    # the whole (B, max_iter) buffer into the journal.
+    sp = np.atleast_2d(np.asarray(tr.step_primal))
+    s_first, s_final, s_changes = [], [], []
+    for b in range(B):
+        s = sp[b, : max(int(n_rec[b]), 0)]
+        s = s[np.isfinite(s)]
+        if s.size == 0:
+            s_first.append(float("nan"))
+            s_final.append(float("nan"))
+            s_changes.append(0)
+            continue
+        s_first.append(float(s[0]))
+        s_final.append(float(s[-1]))
+        s_changes.append(
+            int((np.abs(np.diff(s)) > 1e-12 * np.abs(s[:-1])).sum())
+        )
+    out["step_primal"] = {
+        "first": s_first,
+        "final": s_final,
+        "changes": s_changes,
+    }
+    return out

@@ -418,7 +418,7 @@ def _check_conformance(
 
 def _note_lanes(
     lanes, fields_cls, data, axes, batch, out, entry, lane, wall,
-    *, stats=None,
+    *, stats=None, predicted=None,
 ):
     """Post-drive lane-decision hook shared by the adaptive entry
     points: journal one schema-v6 ``lane_decision`` per solved row
@@ -443,6 +443,10 @@ def _note_lanes(
         obs.note_solve(
             problem, lane, entry=entry, wall=wall,
             iterations=int(its[0]), verdict=v,
+            predicted_iterations=(
+                None if predicted is None
+                else float(predicted.get("iterations", 0.0))
+            ),
         )
         return obs
     share = wall / batch if wall is not None else None
@@ -460,28 +464,58 @@ def _note_lanes(
     return obs
 
 
-def _relane_advice(lanes, lane_policy, problem, native_lane, batch, trace):
-    """Resolve the opt-in ``lane_policy="advice"`` consultation: returns
-    the advised lane when (and only when) the observatory has
-    hysteresis-settled advice for this problem's family that differs
-    from the native lane AND the solve is a shape the paired lane can
-    take over (unbatched, no trace stitching). Anything else returns
-    None — the native path runs untouched, which is what makes the
-    default bitwise-neutral."""
-    if lane_policy is None or lanes is None:
-        return None
-    if lane_policy != "advice":
+def _relane_advice(lanes, lane_policy, problem, native_lane, batch, trace,
+                   lane_model=None, stats=None, pred_out=None):
+    """Resolve the opt-in lane-policy consultation: returns the advised
+    lane when (and only when) the policy names a lane for this problem's
+    family that differs from the native lane AND the solve is a shape
+    the paired lane can take over (unbatched, no trace stitching).
+    Anything else returns None — the native path runs untouched, which
+    is what makes the default bitwise-neutral.
+
+    Policies: None and ``"static"`` never re-lane (``"static"``
+    documents a pinned native lane and is bitwise-neutral by
+    construction). ``"advice"`` consults the observatory's
+    hysteresis-settled ``route_advice``. ``"model"`` consults the
+    learned lane-portfolio router (`learn.laneroute.LaneRouter`,
+    ``lane_model=``) per instance, falling back to the ``"advice"``
+    scoreboards when the model has nothing for this family — the model
+    routes, it never gates correctness. A model prediction fills
+    ``pred_out``/``stats["lane_prediction"]`` with the predicted lane
+    and expected iteration count (the item-4 batch-packing signal) even
+    when it names the native lane."""
+    if lane_policy not in (None, "static", "advice", "model"):
         raise ValueError(
-            f"unknown lane_policy {lane_policy!r} (expected None or 'advice')"
+            f"unknown lane_policy {lane_policy!r} "
+            "(expected None, 'static', 'advice', or 'model')"
         )
+    if lane_policy in (None, "static"):
+        return None
     if batch is not None or trace:
         return None
     from ..obs.lanes import ALTERNATE, as_lanes
 
-    obs = as_lanes(lanes)
-    if obs is None:
-        return None
-    advised = obs.advice_for(problem)
+    obs = as_lanes(lanes) if lanes is not None else None
+    advised = None
+    if lane_policy == "model" and lane_model is not None:
+        from ..learn.laneroute import as_laneroute
+
+        router = as_laneroute(
+            lane_model, fallback=obs.advice if obs is not None else None
+        )
+        pred = router.route(problem) if router is not None else None
+        if pred is not None:
+            advised = pred.lane
+            record = {"lane": pred.lane, "iterations": pred.iterations}
+            if pred_out is not None:
+                pred_out.update(record)
+            if stats is not None:
+                stats["lane_prediction"] = record
+    if advised is None:
+        # "advice", or a model miss falling back to the scoreboards
+        if obs is None:
+            return None
+        advised = obs.advice_for(problem)
     if advised is None or advised == native_lane:
         return None
     if ALTERNATE.get(native_lane) != advised:
@@ -1192,6 +1226,7 @@ def solve_lp_adaptive(
     conformance=None,
     lanes=None,
     lane_policy=None,
+    lane_model=None,
     **solver_kw,
 ):
     """Adaptive-batch version of `solvers.ipm.solve_lp_batch`: identical
@@ -1234,7 +1269,12 @@ def solve_lp_adaptive(
     consults the observatory's hysteresis-settled ``route_advice`` and,
     when it names the paired PDHG lane, re-lanes through the same
     program/row mapping as `runtime.remedy`'s lane switch (the advised
-    lane failing to converge falls back to the native path). Default
+    lane failing to converge falls back to the native path).
+    ``lane_policy="model"`` routes per instance through the learned
+    lane-portfolio model (``lane_model=`` — a `learn.LaneRouter`, an
+    artifact path, or a sequence of paths), falling back to the advice
+    scoreboards when the family is unseen; ``lane_policy="static"``
+    documents a pinned native lane and is bitwise-neutral. Default
     ``lane_policy=None`` never re-lanes."""
     import jax
 
@@ -1244,7 +1284,11 @@ def solve_lp_adaptive(
     t_wall = time.monotonic()
     base_ndim = {"A": 2, "b": 1, "c": 1, "l": 1, "u": 1, "c0": 0}
     axes, batch = _batch_axes(LPData, base_ndim, lp)
-    if _relane_advice(lanes, lane_policy, lp, "dense", batch, trace) == "pdhg":
+    _pred: dict = {}
+    if _relane_advice(
+        lanes, lane_policy, lp, "dense", batch, trace,
+        lane_model=lane_model, stats=stats, pred_out=_pred,
+    ) == "pdhg":
         from ..solvers.pdhg import solve_lp_pdhg
         from .remedy import _ipm_row_from_pdhg, dense_to_sparse
 
@@ -1263,6 +1307,7 @@ def solve_lp_adaptive(
             _note_lanes(
                 lanes, LPData, lp, axes, None, sol0, "solve_lp", "pdhg",
                 time.monotonic() - t_wall, stats=stats,
+                predicted=_pred or None,
             )
             return sol0
         # the advised lane couldn't certify a takeover: native path
@@ -1291,6 +1336,7 @@ def solve_lp_adaptive(
         _note_lanes(
             lanes, LPData, lp, axes, None, sol0, "solve_lp", "dense",
             time.monotonic() - t_wall, stats=stats,
+            predicted=_pred or None,
         )
         return (sol0, tr0) if trace else sol0
     max_iter = solver_kw.get("max_iter", 60)
@@ -1359,8 +1405,9 @@ def solve_lp_banded_adaptive(
     through the banded residual kernel, scattering the reduced solution
     back to the flat frame exactly like `optimal_value_banded`; the
     year-scenario path). `lanes` journals lane decisions; the banded
-    lane has no paired alternate, so `lane_policy="advice"` is accepted
-    but never re-lanes and the observatory never probes these solves."""
+    lane has no paired alternate, so ``lane_policy="advice"`` /
+    ``"model"`` / ``"static"`` are accepted but never re-lane and the
+    observatory never probes these solves."""
     import jax
 
     from ..solvers.ipm import IPMSolution
@@ -1466,6 +1513,7 @@ def solve_lp_pdhg_adaptive(
     conformance=None,
     lanes=None,
     lane_policy=None,
+    lane_model=None,
     **solver_kw,
 ):
     """Adaptive-batch PDHG over a batch of `SparseLP`s sharing one
@@ -1478,9 +1526,13 @@ def solve_lp_pdhg_adaptive(
     to a whole number of convergence-check periods (`check_every`), since
     the PDHG outer loop only observes the counter between checks.
 
-    `lanes` / ``lane_policy="advice"`` mirror `solve_lp_adaptive`: the
+    `lanes` / ``lane_policy="advice"`` / ``"model"`` (with
+    ``lane_model=``) / ``"static"`` mirror `solve_lp_adaptive`: the
     paired alternate here is the dense IPM lane, reached through
-    `runtime.remedy`'s densify + row mapping."""
+    `runtime.remedy`'s densify + row mapping. The PDLP controls
+    (``adaptive_restarts`` / ``primal_weight`` / ``linesearch`` /
+    ``polish``) ride through ``solver_kw`` into `solve_lp_pdhg`
+    unchanged — segmented solves inherit them via `PDHGState`."""
     import jax
 
     from ..core.program import SparseLP
@@ -1492,7 +1544,11 @@ def solve_lp_pdhg_adaptive(
         "c0": 0,
     }
     axes, batch = _batch_axes(SparseLP, base_ndim, lps)
-    if _relane_advice(lanes, lane_policy, lps, "pdhg", batch, trace) == "dense":
+    _pred: dict = {}
+    if _relane_advice(
+        lanes, lane_policy, lps, "pdhg", batch, trace,
+        lane_model=lane_model, stats=stats, pred_out=_pred,
+    ) == "dense":
         from ..solvers.ipm import solve_lp
         from .remedy import _pdhg_row_from_ipm, sparse_to_dense
 
@@ -1509,6 +1565,7 @@ def solve_lp_pdhg_adaptive(
             _note_lanes(
                 lanes, SparseLP, lps, axes, None, sol0, "solve_lp_pdhg",
                 "dense", time.monotonic() - t_wall, stats=stats,
+                predicted=_pred or None,
             )
             return sol0
         # the advised lane couldn't certify a takeover: native path
@@ -1539,6 +1596,7 @@ def solve_lp_pdhg_adaptive(
         _note_lanes(
             lanes, SparseLP, lps, axes, None, sol0, "solve_lp_pdhg",
             "pdhg", time.monotonic() - t_wall, stats=stats,
+            predicted=_pred or None,
         )
         return (sol0, tr0) if trace else sol0
     if axes[0] == 0 or axes[1] == 0:

@@ -441,4 +441,5 @@ def _pdhg_row_from_ipm(isol, slp):
         np.asarray(isol.obj, dt), np.asarray(isol.converged, bool),
         np.asarray(isol.iterations, np.int32),
         np.asarray(isol.res_primal, dt), np.asarray(isol.res_dual, dt),
+        np.asarray(0, np.int32),
     )

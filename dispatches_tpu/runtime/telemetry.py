@@ -248,6 +248,19 @@ def batch_stats(sol) -> dict:
             "max": float(vf.max()),
         }
     stats["nonfinite_count"] = nonfinite
+    # PDLP restart counts (solvers/pdhg.py adaptive_restarts): how often
+    # the batch's solves snapped back to their running averages — the
+    # knob's activity signal, next to the iteration histogram it exists
+    # to shrink. Solutions without the field (IPM, historical journals)
+    # skip it, so pre-PDLP stats render byte-identically.
+    if hasattr(sol, "restarts"):
+        r = np.atleast_1d(np.asarray(sol.restarts, dtype=np.float64))
+        rfin = r[np.isfinite(r)]
+        if rfin.size:
+            stats["restarts"] = {
+                "total": int(rfin.sum()),
+                "max": int(rfin.max()),
+            }
     if hasattr(sol, "status"):
         from ..solvers.ipm import status_name
 

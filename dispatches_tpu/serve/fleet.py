@@ -159,6 +159,7 @@ class FleetService:
         capacity=None,
         lanes=None,
         lane_policy=None,
+        lane_model=None,
     ):
         if not shards:
             raise ValueError("a fleet needs at least one shard")
@@ -227,15 +228,29 @@ class FleetService:
             self.lanes.seed_metrics(name, "dense")
         # opt-in advice consumption ("advice" routes fingerprint-affine
         # dispatches toward shards whose declared lane matches the
-        # observatory's settled route_advice; None = never consulted)
-        if lane_policy not in (None, "advice"):
+        # observatory's settled route_advice; "model" consults the
+        # trained lane-portfolio artifact first and degrades to the
+        # scoreboards when it refuses or the family is unseen; "static"
+        # is an explicit no-routing spelling of None; None = never
+        # consulted)
+        if lane_policy not in (None, "static", "advice", "model"):
             raise ValueError(
                 f"unknown lane_policy {lane_policy!r} "
-                "(expected None or 'advice')"
+                "(expected None, 'static', 'advice', or 'model')"
             )
         self.lane_policy = lane_policy
+        self.lane_model = None
         if lane_policy == "advice" and self.lanes is not None:
             self.router.advice_fn = self.lanes.advice
+        elif lane_policy == "model":
+            from ..learn.laneroute import LaneRouter, as_laneroute
+
+            fb = self.lanes.advice if self.lanes is not None else None
+            self.lane_model = (
+                as_laneroute(lane_model, fallback=fb)
+                or LaneRouter(fallback=fb)
+            )
+            self.router.advice_fn = self.lane_model.advice
         # time-series retention + alerting plane (docs/observability.md
         # §10; off by default and bitwise-neutral for solve results):
         # pump() samples the store on the service clock and evaluates the
@@ -1183,6 +1198,7 @@ def make_dense_fleet(
     capacity=None,
     lanes=None,
     lane_policy=None,
+    lane_model=None,
     **fleet_kw,
 ) -> FleetService:
     """A `FleetService` of `n_shards` dense-LP shard processes, each
@@ -1229,9 +1245,14 @@ def make_dense_fleet(
     ``/lanes`` endpoint plus the `obs.lanes.default_lane_rules` alert
     pack under ``timeseries=True``. ``lane_policy="advice"`` (default
     None = off) lets the router's affinity stage consult the
-    observatory's damped ``route_advice`` — observation stays
-    bitwise-neutral; only the explicit opt-in changes routing
-    (docs/observability.md §14)."""
+    observatory's damped ``route_advice``; ``lane_policy="model"``
+    consults the trained lane-portfolio artifact (``lane_model``, a
+    ``tools/train_laneroute.py`` path or a `learn.laneroute.LaneRouter`)
+    first and degrades to the scoreboards when it refuses or the family
+    is unseen; ``lane_policy="static"`` spells the no-routing default
+    explicitly — observation stays bitwise-neutral; only the explicit
+    opt-in changes routing (docs/observability.md §14,
+    docs/serving.md)."""
     import os
 
     from ..parallel.mesh import shard_device_env
@@ -1262,5 +1283,6 @@ def make_dense_fleet(
         clock=clock, reqtrace=reqtrace, spawn=spawn,
         timeseries=timeseries, conformance=conformance, canary=canary,
         capacity=capacity, lanes=lanes, lane_policy=lane_policy,
+        lane_model=lane_model,
         **fleet_kw,
     )

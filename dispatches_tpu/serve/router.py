@@ -9,10 +9,13 @@ request? Four signals, in order of force:
    least-loaded candidate: latency work buys the shortest line, never a
    warm cache.
 3. **Lane advice** — when the fleet wires `advice_fn` (the lane
-   observatory's damped `route_advice`, opt-in via
-   `lane_policy="advice"`) and the request carries a `family`, shards
-   whose `lane` attribute matches the advised lane are preferred among
-   the free set. Today's dense fleets expose a single lane, so this is
+   observatory's damped `route_advice` under `lane_policy="advice"`, or
+   the trained lane-portfolio model's per-family route under
+   `lane_policy="model"` — `learn.laneroute.LaneRouter.advice`, which
+   itself degrades to the scoreboards when the artifact refuses or the
+   family is unseen) and the request carries a `family`, shards whose
+   `lane` attribute matches the advised lane are preferred among the
+   free set. Today's dense fleets expose a single lane, so this is
    dormant until heterogeneous shards arrive — but the plumbing is
    load-bearing and tested.
 4. **Bucket affinity** — other classes prefer the shard that last
@@ -46,8 +49,10 @@ class Router:
         self.clock = clock
         self._aff: "OrderedDict[str, Tuple[int, float]]" = OrderedDict()
         self._rr = 0
-        # Wired by the fleet under lane_policy="advice"; takes a family
-        # fingerprint and returns the advised lane name (or None).
+        # Wired by the fleet under lane_policy="advice" (observatory
+        # scoreboards) or lane_policy="model" (trained lane portfolio);
+        # takes a family fingerprint and returns the advised lane name
+        # (or None).
         self.advice_fn: Optional[Callable[[str], Optional[str]]] = None
 
     def _fresh(self, fp: str, now: float) -> Optional[int]:

@@ -1,0 +1,319 @@
+"""PDLP completion (solvers/pdhg.py) + learned lane routing
+(learn/laneroute.py): chunked-resume bitwise identity with the adaptive
+controls ON at arbitrary ``it_stop`` boundaries, default-off neutrality
+of the ``"static"`` lane policy, original-frame final residuals agreeing
+with `obs.conformance.kkt_certificates`, the feasibility-polish
+epilogue's accept contract, and the ``lane_policy="model"``
+fallback-to-advice path on artifact mismatch."""
+import json
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dispatches_tpu.core.program import LPData, SparseLP
+from dispatches_tpu.solvers.pdhg import PDHGState, solve_lp_pdhg
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+
+
+def _mk_sparse(seed=0, m=12, n=24, density=0.35, dtype=jnp.float64):
+    r = np.random.default_rng(seed)
+    A = r.normal(size=(m, n)) * (r.random((m, n)) < density)
+    A[np.arange(m), r.integers(0, n, m)] += 1.0  # no empty rows
+    x0 = r.uniform(0.5, 2.5, n)
+    rows, cols = np.nonzero(A)
+    return SparseLP(
+        jnp.asarray(rows, jnp.int32), jnp.asarray(cols, jnp.int32),
+        jnp.asarray(A[rows, cols], dtype), jnp.asarray(A @ x0, dtype),
+        jnp.asarray(r.normal(size=n), dtype),
+        jnp.zeros(n, dtype), jnp.full(n, 4.0, dtype),
+        jnp.asarray(0.0, dtype),
+    )
+
+
+_CTL = dict(adaptive_restarts=True, primal_weight=True, linesearch=True)
+
+
+class TestChunkedResumeBitwise:
+    """The segmented-solve primitive with every PDLP control ON must
+    reproduce the one-shot iterate sequence bitwise — the contract
+    `runtime/adaptive.py`, the serve bucket, and the remedy lane switch
+    all rely on (they never know whether a solve was chunked)."""
+
+    @pytest.mark.parametrize("boundaries", [
+        (200, 6000),
+        (1000, 1400, 6000),
+        (200, 1000, 1400, 4200, 6000),
+    ])
+    def test_pdlp_controls_resume_bitwise(self, boundaries):
+        lp = _mk_sparse(3)
+        one, st_one = solve_lp_pdhg(
+            lp, tol=1e-9, max_iter=6000, return_state=True, **_CTL
+        )
+        st = None
+        for stop in boundaries:
+            seg, st = solve_lp_pdhg(
+                lp, tol=1e-9, max_iter=6000, state=st, it_stop=stop,
+                return_state=True, **_CTL
+            )
+        assert _biteq(seg.x, one.x)
+        assert _biteq(seg.y, one.y)
+        assert _biteq(seg.obj, one.obj)
+        assert _biteq(seg.iterations, one.iterations)
+        assert _biteq(seg.converged, one.converged)
+        assert _biteq(seg.restarts, one.restarts)
+        # the resumable state itself (incl. the PDLP bookkeeping fields)
+        for name in PDHGState._fields:
+            if name == "trace":
+                continue
+            assert _biteq(getattr(st, name), getattr(st_one, name)), name
+
+    def test_pdlp_controls_resume_bitwise_traced(self):
+        lp = _mk_sparse(4)
+        one, tr_one = solve_lp_pdhg(
+            lp, tol=1e-9, max_iter=4000, trace=True, **_CTL
+        )
+        st = None
+        for stop in (1000, 2200, 4000):
+            seg, tr, st = solve_lp_pdhg(
+                lp, tol=1e-9, max_iter=4000, trace=True, state=st,
+                it_stop=stop, return_state=True, **_CTL
+            )
+        assert _biteq(seg.x, one.x)
+        for f in tr._fields:
+            assert _biteq(getattr(tr, f), getattr(tr_one, f)), f
+
+    def test_historical_defaults_resume_bitwise(self):
+        # the padded-state path: every control off, chunked vs one-shot
+        lp = _mk_sparse(5)
+        one = solve_lp_pdhg(lp, tol=1e-9, max_iter=4000)
+        st = None
+        for stop in (600, 2000, 4000):
+            seg, st = solve_lp_pdhg(
+                lp, tol=1e-9, max_iter=4000, state=st, it_stop=stop,
+                return_state=True,
+            )
+        assert _biteq(seg.x, one.x)
+        assert _biteq(seg.y, one.y)
+        assert _biteq(seg.iterations, one.iterations)
+
+
+class TestPDLPControls:
+    def test_controls_converge_and_count_restarts(self):
+        lp = _mk_sparse(6)
+        base = solve_lp_pdhg(lp, tol=1e-7, max_iter=60_000)
+        tuned = solve_lp_pdhg(lp, tol=1e-7, max_iter=60_000, **_CTL)
+        assert bool(np.asarray(base.converged))
+        assert bool(np.asarray(tuned.converged))
+        assert int(np.asarray(base.restarts)) == 0
+        assert int(np.asarray(tuned.restarts)) >= 1
+        # adaptive restarts must not be slower than restart-every-check
+        assert int(np.asarray(tuned.iterations)) <= int(
+            np.asarray(base.iterations)
+        )
+
+    def test_linesearch_traces_step_trajectory(self):
+        lp = _mk_sparse(7)
+        _, tr = solve_lp_pdhg(
+            lp, tol=1e-9, max_iter=2000, trace=True, linesearch=True,
+        )
+        steps = np.asarray(tr.step_primal)
+        steps = steps[np.isfinite(steps) & (steps > 0)]
+        # the adaptive step must actually move (historical = constant)
+        assert steps.size >= 2 and np.unique(steps).size >= 2
+
+    def test_polish_accept_contract(self):
+        # stop far from convergence so the primal residual is material:
+        # polish must never worsen res_primal / the KKT score sum
+        lp = _mk_sparse(8)
+        rough = solve_lp_pdhg(lp, tol=1e-9, max_iter=400)
+        pol = solve_lp_pdhg(lp, tol=1e-9, max_iter=400, polish=True)
+        rp_r = float(np.asarray(rough.res_primal))
+        rp_p = float(np.asarray(pol.res_primal))
+        sum_r = rp_r + float(np.asarray(rough.res_dual))
+        sum_p = rp_p + float(np.asarray(pol.res_dual))
+        assert rp_p <= rp_r
+        assert sum_p <= sum_r
+        # output-only: y and the iterate bookkeeping are untouched
+        assert _biteq(pol.y, rough.y)
+        assert _biteq(pol.iterations, rough.iterations)
+
+    def test_polish_resume_stays_bitwise(self):
+        # polish touches the OUTPUT x only, never the carried state
+        lp = _mk_sparse(9)
+        _, st_p = solve_lp_pdhg(
+            lp, tol=1e-9, max_iter=1000, it_stop=400, return_state=True,
+            polish=True,
+        )
+        _, st = solve_lp_pdhg(
+            lp, tol=1e-9, max_iter=1000, it_stop=400, return_state=True,
+        )
+        assert _biteq(st_p.x, st.x)
+        assert _biteq(st_p.y, st.y)
+
+
+class TestOriginalFrameResiduals:
+    def test_final_residuals_match_conformance(self):
+        from dispatches_tpu.obs.conformance import FIELDS, kkt_certificates
+
+        lp = _mk_sparse(10)
+        sol = solve_lp_pdhg(lp, tol=1e-7, max_iter=60_000)
+        cert = np.asarray(kkt_certificates(lp, sol))
+        fields = dict(zip(FIELDS, cert))
+        rp = float(np.asarray(sol.res_primal))
+        rd = float(np.asarray(sol.res_dual))
+        assert rp == pytest.approx(fields["res_primal"], rel=1e-9, abs=1e-12)
+        assert rd == pytest.approx(fields["res_dual"], rel=1e-9, abs=1e-12)
+
+    def test_residual_frame_under_controls(self):
+        from dispatches_tpu.obs.conformance import FIELDS, kkt_certificates
+
+        lp = _mk_sparse(11)
+        sol = solve_lp_pdhg(lp, tol=1e-7, max_iter=60_000, polish=True,
+                            **_CTL)
+        cert = np.asarray(kkt_certificates(lp, sol))
+        fields = dict(zip(FIELDS, cert))
+        assert float(np.asarray(sol.res_primal)) == pytest.approx(
+            fields["res_primal"], rel=1e-9, abs=1e-12
+        )
+        assert float(np.asarray(sol.res_dual)) == pytest.approx(
+            fields["res_dual"], rel=1e-9, abs=1e-12
+        )
+
+
+def _probe_dataset(slps, fam, winner="dense"):
+    from dispatches_tpu.learn.dataset import (
+        DEFAULT_VARYING, WarmStartDataset, features_of,
+    )
+    from dispatches_tpu.learn.laneroute import PROBE_TARGETS
+
+    X = np.stack([features_of(p) for p in slps])
+    r = np.random.default_rng(1)
+    wd, wp = (0.01, 1.0) if winner == "dense" else (1.0, 0.01)
+    Y = np.stack([
+        [wd * (1 + 0.1 * r.random()), wp * (1 + 0.1 * r.random()),
+         9 + r.integers(0, 3), 900 + r.integers(0, 50), 1]
+        for _ in slps
+    ]).astype(np.float64)
+    return WarmStartDataset(
+        X, Y, family=fam, varying=list(DEFAULT_VARYING),
+        targets=[list(t) for t in PROBE_TARGETS], problem_type="SparseLP",
+    )
+
+
+class TestLanePolicyModel:
+    def test_static_policy_is_bitwise_neutral(self):
+        from dispatches_tpu.runtime.adaptive import solve_lp_pdhg_adaptive
+
+        lp = _mk_sparse(12)
+        base = solve_lp_pdhg_adaptive(lp, tol=1e-7, max_iter=20_000)
+        stats = {}
+        static = solve_lp_pdhg_adaptive(
+            lp, tol=1e-7, max_iter=20_000, lane_policy="static",
+            stats=stats,
+        )
+        assert stats.get("relaned") is None
+        for f in ("x", "y", "obj", "converged", "iterations"):
+            assert _biteq(getattr(static, f), getattr(base, f)), f
+
+    def test_model_routes_and_fallback_on_mismatch(self, tmp_path):
+        from dispatches_tpu.learn import ArtifactMismatch
+        from dispatches_tpu.learn.dataset import family_fingerprint
+        from dispatches_tpu.learn.laneroute import (
+            LaneRouteModel, LaneRouter, as_laneroute,
+            train_laneroute_model,
+        )
+        from dispatches_tpu.obs import metrics as obs_metrics
+        from dispatches_tpu.obs.lanes import LaneConfig, LaneObservatory
+        from dispatches_tpu.runtime.adaptive import solve_lp_pdhg_adaptive
+
+        slps = [_mk_sparse(100 + s) for s in range(16)]
+        # one family: share the structural fields, vary only b and c
+        ref = slps[0]
+        slps = [
+            SparseLP(ref.rows, ref.cols, ref.vals, p.b, p.c, ref.l,
+                     ref.u, ref.c0)
+            for p in slps
+        ]
+        fam = family_fingerprint(slps[0])
+        model, _ = train_laneroute_model(
+            _probe_dataset(slps, fam), epochs=120, seed=0
+        )
+        path = model.save(str(tmp_path / "lanes.npz"))
+
+        # structurally wrong artifacts refuse to load (operator error)
+        with pytest.raises(ArtifactMismatch):
+            LaneRouteModel.load(path, expect_family="0" * 64)
+        with np.load(path, allow_pickle=False) as dat:
+            payload = {k: dat[k] for k in dat.files}
+        manifest = json.loads(str(payload["__manifest__"]))
+        manifest["kind"] = "warmstart"
+        payload["__manifest__"] = np.asarray(json.dumps(manifest))
+        bad = tmp_path / "bad.npz"
+        np.savez(bad, **payload)
+        with pytest.raises(ArtifactMismatch):
+            LaneRouteModel.load(str(bad))
+        with pytest.raises(ArtifactMismatch):
+            as_laneroute(str(bad))
+
+        # the trained model re-lanes its own family to the dense/IPM lane
+        router = as_laneroute(path)
+        stats = {}
+        sol = solve_lp_pdhg_adaptive(
+            slps[0], stats=stats, lane_policy="model", lane_model=router,
+        )
+        assert stats.get("relaned") == "dense"
+        assert stats["lane_prediction"]["lane"] == "dense"
+        assert stats["lane_prediction"]["iterations"] >= 1.0
+        assert bool(np.all(np.asarray(sol.converged)))
+
+        # unseen family: the model abstains (counted) and the policy
+        # falls back to the observatory's advice scoreboards
+        other = _mk_sparse(55)
+        obs = LaneObservatory(LaneConfig(probe_fraction=0.0))
+        obs.force_advice(family_fingerprint(other), "dense")
+        before = obs_metrics.flat_values()
+        stats2 = {}
+        sol2 = solve_lp_pdhg_adaptive(
+            other, stats=stats2, lanes=obs, lane_policy="model",
+            lane_model=LaneRouter([model], fallback=None),
+        )
+        after = obs_metrics.flat_values()
+        key = 'lane_model_fallback_total{reason="unseen_family"}'
+        assert after.get(key, 0.0) > before.get(key, 0.0)
+        assert stats2.get("relaned") == "dense"  # advice took over
+        assert "lane_prediction" not in stats2
+        assert bool(np.all(np.asarray(sol2.converged)))
+
+        # and with no advice either: native lane, still healthy
+        stats3 = {}
+        sol3 = solve_lp_pdhg_adaptive(
+            other, stats=stats3, lane_policy="model", lane_model=router,
+            tol=1e-6, max_iter=60_000,
+        )
+        assert stats3.get("relaned") is None
+        assert bool(np.all(np.asarray(sol3.converged)))
+
+    def test_unknown_policy_raises(self):
+        from dispatches_tpu.runtime.adaptive import solve_lp_pdhg_adaptive
+
+        with pytest.raises(ValueError, match="lane_policy"):
+            solve_lp_pdhg_adaptive(_mk_sparse(13), lane_policy="bogus")
+
+    def test_fleet_validates_and_wires_model_policy(self):
+        from dispatches_tpu.serve.fleet import FleetService
+        from dispatches_tpu.serve.shard import ShardProcess
+
+        shards = [ShardProcess(0, bucket=4, chunk_iters=2, solver_kw={})]
+        svc = FleetService(shards, spawn=False, lane_policy="model")
+        assert svc.lane_model is not None
+        assert svc.router.advice_fn is not None
+        assert svc.router.advice_fn("nope") is None
+        svc2 = FleetService(shards, spawn=False, lane_policy="static")
+        assert svc2.router.advice_fn is None
+        with pytest.raises(ValueError, match="lane_policy"):
+            FleetService(shards, spawn=False, lane_policy="bogus")

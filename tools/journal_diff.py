@@ -92,6 +92,13 @@ _HIGHER_IS_BETTER = (
     # never enters the surface at all — lane codes are nominal, not
     # ordinal.
     "lane_win_ratio",
+    # learned lane routing (learn/laneroute.py): the model TAKING routes
+    # is the plane working — the bad direction is the count dropping on
+    # a same workload (the model silently ceding every decision back to
+    # the scoreboards). lane_model_fallback_total falls through to
+    # lower-is-better: a fallback storm appearing (unseen families,
+    # feature mismatches, predict errors) is the artifact aging out.
+    "lane_model_route_total",
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
@@ -180,6 +187,16 @@ _ZERO_SEEDED = (
     # opt-in observatory is attached, so a probe-on run against a
     # probe-off baseline must not trip the gate.
     'outcome="regret"',
+    # learned lane routing (learn/laneroute.py): LaneRouter zero-seeds
+    # both counter families at construction, but a baseline journal
+    # written before lane_policy="model" existed has neither — seeding
+    # here makes a fallback storm (unseen families, feature mismatches,
+    # predict errors) appearing in NEW a gated regression instead of an
+    # uncompared curiosity. Route counts seed too but, as
+    # higher-is-better, only gate on a same-workload DROP — the model
+    # silently ceding every decision back to the scoreboards — never on
+    # the model plane being switched on against a policy-off baseline.
+    "lane_model_fallback_total", "lane_model_route_total",
 )
 
 
@@ -1234,6 +1251,55 @@ def self_check(out=sys.stdout) -> int:
         table.get('metric/lane_win_ratio{family="abc123",lane="dense"}')
         == 0.75
         and 'metric/route_advice{family="abc123"}' not in table))
+
+    # learned lane routing (learn/laneroute.py): fallback storms gate
+    # lower-is-better and from zero (the artifact aging out of its
+    # traffic), route counts gate only on a same-workload drop (the
+    # model ceding decisions back to the scoreboards), and a model-on
+    # run whose fallbacks stay zero passes against a policy-off baseline
+    mbase = {
+        'metric/lane_model_route_total{lane="dense"}': 40.0,
+        'metric/lane_model_route_total{lane="pdhg"}': 24.0,
+        'metric/lane_model_fallback_total{reason="unseen_family"}': 0.0,
+        'metric/lane_model_fallback_total{reason="feature_mismatch"}': 0.0,
+        'metric/lane_model_fallback_total{reason="error"}': 0.0,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def mrun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(mbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    mrun("identical lane-model counters pass", dict(mbase), False)
+    mrun("fallbacks appearing from zero fail (unseen families)",
+         {**mbase,
+          'metric/lane_model_fallback_total{reason="unseen_family"}': 5.0},
+         True)
+    mrun("predict errors appearing from zero fail",
+         {**mbase,
+          'metric/lane_model_fallback_total{reason="error"}': 1.0}, True)
+    mrun("model route count dropping >10% fails (decisions ceded back)",
+         {**mbase,
+          'metric/lane_model_route_total{lane="dense"}': 10.0}, True)
+    mrun("model taking more routes passes (higher is better)",
+         {**mbase,
+          'metric/lane_model_route_total{lane="dense"}': 80.0}, False)
+    cleanm = {"serve/loadgen/goodput_rps": 120.0}
+    rows = compare(cleanm, {
+        **cleanm,
+        'metric/lane_model_route_total{lane="dense"}': 40.0,
+        'metric/lane_model_fallback_total{reason="unseen_family"}': 0.0,
+    })
+    checks.append((
+        "model-on run with zero fallbacks passes vs policy-off baseline",
+        False, any(r["regression"] for r in rows)))
+    rows = compare(cleanm, {
+        **cleanm,
+        'metric/lane_model_fallback_total{reason="feature_mismatch"}': 3.0,
+    })
+    checks.append((
+        "fallbacks vs policy-off baseline still fail (zero-seeded)",
+        True, any(r["regression"] for r in rows)))
 
     ok = True
     for name, want, got in checks:

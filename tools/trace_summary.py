@@ -41,6 +41,11 @@ manifest record). For each run this prints:
   shares from ``lane_decision`` events plus shadow-probe outcome and
   regret counts from ``lane_probe`` events — pre-v6 journals and
   plane-off runs render exactly as before;
+- when the run holds schema-v7 PDLP fields (solvers/pdhg.py with the
+  adaptive controls on), a ``restarts=`` column on solve lines whose
+  batch_stats carry a restart count and first->final step-size columns
+  (with the recorded change count) on trace sub-lines — pre-v7 journals
+  and control-off runs render exactly as before;
 - cumulative retrace counts from the close record (or summed span deltas
   for a run that died before closing).
 
@@ -272,6 +277,15 @@ def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
     )
     if stats.get("nonfinite_count"):
         line += f" nonfinite={stats['nonfinite_count']}"
+    # PDLP restart counts (schema-v7 batch_stats from solutions carrying
+    # a `restarts` field): how often the batch snapped back to its
+    # running averages. Pre-PDLP journals lack the key and render
+    # exactly as before.
+    rst = stats.get("restarts")
+    if isinstance(rst, dict) and rst.get("total"):
+        line += f" restarts={rst['total']}"
+        if rst.get("max", 0) != rst["total"]:
+            line += f"(max {rst['max']})"
     # adaptive-batching columns (runtime/adaptive.py): the sweep
     # runners attach these as solve-event attrs
     if ev.get("warm_starts"):
@@ -341,7 +355,27 @@ def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
         nd = tr.get("n_divergent", 0)
         flag = f"  DIVERGENT x{nd}" if nd else ""
         rng = f"{min(rec)}..{max(rec)}" if rec else "none"
-        print(f"      trace: recorded iters {rng}{flag}", file=out)
+        # step-size trajectory columns (schema-v7 trace_stats): first ->
+        # final primal step plus how many recorded changes — a line
+        # search or primal-weight rebalance shows activity, a constant-
+        # step solve shows ->(0 changes). Older trace dicts lack the key
+        # and render exactly as before.
+        step_txt = ""
+        sp = tr.get("step_primal")
+        if isinstance(sp, dict) and sp.get("first"):
+            firsts = [v for v in sp["first"]
+                      if isinstance(v, (int, float)) and v == v]
+            finals = [v for v in sp.get("final", [])
+                      if isinstance(v, (int, float)) and v == v]
+            changes = [v for v in sp.get("changes", [])
+                       if isinstance(v, (int, float))]
+            if firsts and finals:
+                step_txt = (
+                    f"  step {firsts[0]:.3g}->{finals[0]:.3g}"
+                    f" ({max(changes) if changes else 0} changes"
+                    + (" max" if len(firsts) > 1 else "") + ")"
+                )
+        print(f"      trace: recorded iters {rng}{step_txt}{flag}", file=out)
     cost = ev.get("cost")
     if isinstance(cost, dict):
         parts = []
