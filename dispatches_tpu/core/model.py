@@ -40,6 +40,7 @@ class Model:
         self._obj: Optional[Expr] = None
         self._obj_sense = 1.0  # 1.0 = minimize
         self._exprs: Dict[str, Expr] = {}
+        self._row_marks: List[Tuple[str, str, int]] = []
 
     # ------------------------------------------------------------------
     def var(
@@ -95,6 +96,23 @@ class Model:
         e = self._as_expr(rhs) - lhs
         self._le.append(e)
         return e
+
+    def mark_rows(self, name: str, kind: str = "eq") -> None:
+        """Open a named row region: every ``kind`` constraint added from
+        here until the next ``mark_rows(..., kind)`` call (or the end of
+        the model) lands in the region. Lowering resolves each region to
+        a global ``[start, stop)`` row range on the built program
+        (``CompiledLP.row_ranges``), so consumers that slice rows — LMP
+        extraction, contingency row masking — name the region instead of
+        hand-counting ordinals that silently skew when constraints are
+        added above them."""
+        if kind not in ("eq", "le"):
+            raise ValueError(f"mark_rows kind must be 'eq' or 'le', got {kind!r}")
+        if any(n == name for n, _, _ in self._row_marks):
+            raise ValueError(f"duplicate row mark {name!r}")
+        self._row_marks.append(
+            (name, kind, len(self._eq if kind == "eq" else self._le))
+        )
 
     def expression(self, name: str, e) -> Expr:
         """Register a named affine expression for post-solve evaluation

@@ -172,6 +172,21 @@ class CompiledLP:
             le_offs.append(off)
             off += e.R
 
+        # named row regions (Model.mark_rows): resolve each mark's
+        # constraint-list index to a global row range [start, stop). A
+        # region closes at the next mark of the same kind or at the end
+        # of that kind's rows. Deliberately EXCLUDED from fingerprint():
+        # naming rows is metadata, not problem identity — marked and
+        # unmarked builds of the same model stay fingerprint-identical.
+        self.row_ranges = {}
+        for kind, offs, hi in (("eq", eq_offs, Me), ("le", le_offs, Me + Mi)):
+            marks = [(ci, name) for name, k, ci in m._row_marks if k == kind]
+            for pos, (ci, name) in enumerate(marks):
+                start = offs[ci] if ci < len(offs) else hi
+                nxt = marks[pos + 1][0] if pos + 1 < len(marks) else len(offs)
+                stop = offs[nxt] if nxt < len(offs) else hi
+                self.row_ranges[name] = (int(start), int(stop))
+
         (t, tp, c, cp) = _collect(m._eq + m._le, eq_offs + le_offs)
 
         # original-variable bounds and fixed-variable presolve: columns with

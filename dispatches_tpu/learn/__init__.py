@@ -13,9 +13,14 @@ journaled solves, serve them through the safeguarded warm-start path.
   observatory's probe-pair shards, predicting ``(best_lane,
   expected_iterations)`` per problem; served as ``lane_policy="model"``
   with fallback to the measured advice scoreboards.
+- `learn.screener` — per-family N-1 criticality predictor trained on
+  full `secure_dispatch` runs, shrinking the contingency screen; every
+  screened solve is verified post-solve against the full set, so a bad
+  screen costs a re-solve, never a missed violation.
 
-See docs/learned_warmstarts.md; the CLIs are tools/train_warmstart.py
-and tools/train_laneroute.py.
+See docs/learned_warmstarts.md and docs/market.md; the CLIs are
+tools/train_warmstart.py, tools/train_laneroute.py, and
+tools/train_screener.py.
 """
 from .dataset import (
     DatasetWriter,
@@ -40,23 +45,37 @@ from .laneroute import (
     as_laneroute,
     train_laneroute_model,
 )
+from .screener import (
+    SCREENER_VERSION,
+    ContingencyScreener,
+    ScreenerModel,
+    as_screener,
+    screen_targets,
+    train_screener_model,
+)
 
 __all__ = [
     "ARTIFACT_VERSION",
     "ArtifactMismatch",
+    "ContingencyScreener",
     "DatasetWriter",
     "LANEROUTE_VERSION",
     "LaneRouteModel",
     "LaneRouter",
     "RoutePrediction",
+    "SCREENER_VERSION",
+    "ScreenerModel",
     "WarmStartDataset",
     "WarmStartModel",
     "WarmStartPredictor",
     "as_laneroute",
+    "as_screener",
     "family_fingerprint",
     "features_of",
     "load_dataset",
+    "screen_targets",
     "targets_of",
     "train_laneroute_model",
+    "train_screener_model",
     "train_warmstart_model",
 ]

@@ -2,6 +2,21 @@
 IDAES grid_integration (bidder/tracker/coordinator) plus the in-framework
 production-cost simulators (single-bus merit order and 5-bus DC-OPF)."""
 
+from .contingency import (
+    Contingency,
+    ContingencySet,
+    ScreenResult,
+    SecureDispatch,
+    base_operating_point,
+    contingency_dcopf_program,
+    contingency_params,
+    lodf_matrix,
+    post_contingency_flows,
+    ptdf_matrix,
+    screen_contingencies,
+    secure_dispatch,
+    stack_contingency_lp,
+)
 from .bidder import (
     BatteryParametrizedBidder,
     ParametrizedBidder,

@@ -667,16 +667,18 @@ def dcopf_program(
     participant_bus: Optional[int] = None,
     reserve: bool = False,
     reserve_shortfall_price: float = 250.0,
+    flow_cuts: Optional[list] = None,
 ):
     """Lower the single-hour DC-OPF to a parametric LP.
 
     Params: ``load`` (n_bus,), ``ren_cap`` (n_ren,), ``commit`` (n_thermal,)
     0/1 mask, and optionally a participant bid stack ``bid_mw``/``bid_cost``
     (n_participant_segments,) clearing at ``participant_bus`` (a bus id from
-    the bus table; defaults to the first bus). The balance rows start at
-    ``prog.balance_row0`` in bus-table order, so
-    ``IPMSolution.y[balance_row0 : balance_row0 + n_bus]`` are the bus LMPs
-    (see :func:`solve_hours`).
+    the bus table; defaults to the first bus). The balance rows are the
+    named ``"balance"`` row region (``prog.row_ranges["balance"]``) in
+    bus-table order; ``prog.balance_row0`` stays available as a derived
+    alias, so ``IPMSolution.y[balance_row0 : balance_row0 + n_bus]`` are
+    the bus LMPs (see :func:`solve_hours`).
 
     ``reserve=True`` adds a spinning-reserve product (param
     ``reserve_req`` (1,)): per committed thermal unit a reserve variable
@@ -684,6 +686,15 @@ def dcopf_program(
     priced reserve shortfall — the reference's Prescient runs carry
     reserves through the SCED stage too, not just the RUC
     (`prescient_options.py:23`, round-1 verdict weak #8).
+
+    ``flow_cuts`` is the security-constraint hook used by the N-1
+    constraint-generation loop (`market/contingency.py`): a list of
+    ``(coeffs, rhs)`` pairs, each adding one inequality
+    ``sum_m coeffs[m] * flow_m <= rhs`` over base-case branch flows
+    (LODF-projected post-contingency limits). Cuts append ≤ rows after
+    every existing constraint, so row regions — and therefore LMP
+    extraction — are unchanged; ``flow_cuts=None`` builds a program
+    bitwise-identical to one lowered without the argument.
     """
     nb = len(grid.buses)
     m = Model("dcopf")
@@ -694,6 +705,7 @@ def dcopf_program(
     # per-segment thermal dispatch
     seg_vars, seg_costs, seg_bus = [], [], []
     base_vars = []  # p_min block per committed unit
+    m.mark_rows("base_commit")
     for gi, g in enumerate(grid.thermal):
         base = m.var(f"{g.name}.base")  # = p_min * commit
         m.add_eq(base - commit[gi : gi + 1] * g.p_min)
@@ -726,12 +738,10 @@ def dcopf_program(
     theta = m.var("theta", nb, lb=-100.0, ub=100.0)
     slack = m.var("shortfall", nb)  # load shed at shortfall price
 
-    # branch flows f = b*(theta_from - theta_to), limit both directions
-    # bus balance rows FIRST would require reordering; instead record their
-    # ordinal: eq rows are emitted in add_eq order — the base/commit rows
-    # came first, so balance rows start after n_thermal of them
-    balance_row0 = len(grid.thermal)  # one eq row per thermal base var
-
+    # branch flows f = b*(theta_from - theta_to), limit both directions.
+    # Row regions are named via mark_rows — eq rows are emitted in add_eq
+    # order, and the lowering resolves each named region to its global
+    # [start, stop) range, so nothing here hand-counts ordinals.
     inj = [None] * nb
     def add_inj(i, expr):
         inj[i] = expr if inj[i] is None else inj[i] + expr
@@ -743,6 +753,7 @@ def dcopf_program(
     for u, v in zip(grid.renewable, ren_vars):
         add_inj(grid.bus_index(u.bus), v + 0.0)
     flows = []
+    m.mark_rows("flow_def")
     for li in range(len(grid.branch_b)):
         i, j = int(grid.branch_from[li]), int(grid.branch_to[li])
         b = float(grid.branch_b[li])
@@ -750,13 +761,13 @@ def dcopf_program(
                   ub=float(grid.branch_limit[li]))
         m.add_eq(f - b * theta[i : i + 1] + b * theta[j : j + 1])
         flows.append((f, i, j))
-    balance_row0 += len(grid.branch_b)  # flow-definition eq rows precede
 
     # reference angle
+    m.mark_rows("ref_angle")
     m.add_eq(theta[0:1])
-    balance_row0 += 1
 
     # bus balances (these rows' duals are the LMPs)
+    m.mark_rows("balance")
     for bi_ in range(nb):
         expr = slack[bi_ : bi_ + 1] - load[bi_ : bi_ + 1]
         if inj[bi_] is not None:
@@ -797,11 +808,24 @@ def dcopf_program(
         m.add_ge(r_total - reserve_req)
         cost = cost + reserve_shortfall_price * rshort
 
+    if flow_cuts:
+        # security cuts over base-case flows (see docstring): appended
+        # last so every pre-existing row keeps its ordinal
+        for coeffs, rhs in flow_cuts:
+            expr = None
+            for li, coef in sorted(coeffs.items()):
+                term = float(coef) * flows[li][0]
+                expr = term if expr is None else expr + term
+            if expr is not None:
+                m.add_le(expr - float(rhs))
+
     m.expression("total_cost", cost)
     m.minimize(cost)
 
     prog = m.build()
-    prog.balance_row0 = balance_row0
+    # derived alias: the balance region's start row (kept for existing
+    # callers; the named range is the source of truth)
+    prog.balance_row0 = prog.row_ranges["balance"][0]
     prog.n_bus = nb
     return prog
 

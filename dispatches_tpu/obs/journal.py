@@ -66,7 +66,19 @@ from . import retrace as _retrace
 #     re-solve — both lanes' measured walls/iterations, regret, outcome,
 #     cache-defeating probe fingerprint. Solve records gain an optional
 #     "lane" attr.
-_SCHEMA_VERSION = 6
+# v7: PDLP completion — solve batch_stats gain an optional "restarts"
+#     count and trace stats gain step-size trajectory fields from the
+#     restarted/adaptive primal-dual path. Additive-only.
+#     (Retroactively documented: these records shipped while the
+#     constant still said 6.)
+# v8: "contingency_event" records (market.contingency): one per
+#     constraint-generation round (phase="round": evaluated set size,
+#     violations, cuts) plus a final summary (phase="final": K, rounds,
+#     feasible, escaped, screened, shrink) and a screen summary
+#     (phase="screen"); "contingency_fleet" / "screener_artifact" driver
+#     events; solve records gain an optional "ctg" attr (contingency id
+#     or screened/full marker). Additive-only.
+_SCHEMA_VERSION = 8
 
 
 def _git_sha() -> Optional[str]:

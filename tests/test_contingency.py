@@ -1,0 +1,679 @@
+"""N-1 contingency SCED (market/contingency.py + learn/screener.py).
+
+Covers the subsystem's load-bearing contracts:
+
+- PTDF/LODF host math against direct solves on the outaged topology
+  (the LODF projection is the CG loop's only view of post-contingency
+  flows — if it drifts, "N-1 feasible" means nothing);
+- the one-lowered-program batched screen: K contingencies through
+  `solve_lp_adaptive` bitwise-equal to the one-shot batched IPM, with
+  the compile counters proving ONE executable covered the whole batch;
+- named row regions (`mark_rows` -> `CompiledLP.row_ranges`) on both
+  the base and contingency programs;
+- `secure_dispatch`: screener-off bitwise identity with the plain SCED
+  when no cuts are needed, constraint-generation convergence to zero
+  escaped violations on a tightened grid, and the screened path's
+  safeguard (a blind screener's missed violations are caught by the
+  full-set verify and repaired by fallback — never escaped);
+- screener artifacts: train/save/load round trip plus every
+  refuse-to-load mode (`ArtifactMismatch` is loud, serve-side fallback
+  is silent and counted);
+- `tools/trace_summary.py` schema-v8 surface: ``ctg=`` column and the
+  contingency footer render from v8 records and stay entirely absent
+  for pre-v8 journals.
+"""
+import dataclasses
+import importlib
+import io
+import json
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dispatches_tpu.learn.screener import (
+    DEFAULT_THRESHOLD,
+    SCREEN_VARYING,
+    SCREENER_KIND,
+    SCREENER_VERSION,
+    ContingencyScreener,
+    ScreenerModel,
+    as_screener,
+    screen_targets,
+    train_screener_model,
+)
+from dispatches_tpu.learn.warmstart import ArtifactMismatch
+from dispatches_tpu.market.contingency import (
+    ABS_TOL,
+    Contingency,
+    ContingencySet,
+    base_operating_point,
+    contingency_dcopf_program,
+    contingency_params,
+    lodf_matrix,
+    post_contingency_flows,
+    ptdf_matrix,
+    screen_contingencies,
+    secure_dispatch,
+    stack_contingency_lp,
+)
+from dispatches_tpu.market.network import dcopf_program, synthesize_network
+from dispatches_tpu.obs import metrics as obs_metrics
+from dispatches_tpu.solvers.ipm import solve_lp, solve_lp_batch
+
+KW = dict(max_iter=60)
+
+
+def _biteq(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and bool(
+        np.all((a == b) | (np.isnan(a) & np.isnan(b)))
+    )
+
+
+def _soften(u, k=0.15):
+    """Lower a unit's must-run floor, rescaling the cost-segment widths
+    (baked from the ORIGINAL p_min at synthesis) so max output still
+    reaches p_max — without the rescale, output caps at
+    ``p_min_soft + sum(seg_mw)``."""
+    pmin = k * u.p_min
+    scale = (u.p_max - pmin) / max(u.p_max - u.p_min, 1e-9)
+    return dataclasses.replace(
+        u, p_min=pmin, seg_mw=np.asarray(u.seg_mw, float) * scale
+    )
+
+
+@pytest.fixture(scope="module")
+def grid6():
+    return synthesize_network(n_buses=6, n_units=4, days=1, seed=0)
+
+
+@pytest.fixture(scope="module")
+def flex6(grid6):
+    """grid6 with softened must-run floors: every N-1 topology stays
+    correctively feasible (full p_min commits strand minimum generation
+    under an outage — DC-OPF has no over-generation slack), so serial
+    reference solves converge."""
+    g = dataclasses.replace(
+        grid6, thermal=[_soften(u) for u in grid6.thermal]
+    )
+    return g, base_operating_point(g)
+
+
+@pytest.fixture(scope="module")
+def tight8():
+    """The violation regime: softened must-run floors + 0.75x branch
+    limits leave the merit-order base dispatch feasible but N-1
+    insecure, so the CG loop has real work (same recipe as
+    tools/train_screener.py --self-check)."""
+    g = synthesize_network(n_buses=8, n_units=6, days=1, seed=0)
+    g = dataclasses.replace(
+        g,
+        thermal=[_soften(u) for u in g.thermal],
+        branch_limit=np.asarray(g.branch_limit, float) * 0.75,
+    )
+    params = base_operating_point(g, hour=0)
+    rng = np.random.default_rng(7)
+    params["load"] = params["load"] * rng.uniform(
+        1.0, 1.1, size=params["load"].shape
+    )
+    return g, params
+
+
+def _injections(grid, seed=0):
+    """A balanced net-injection vector (withdrawn at the reference bus),
+    matching the PTDF's ``theta[0] = 0`` convention."""
+    nb = len(grid.buses)
+    p = np.random.default_rng(seed).uniform(-1.0, 1.0, nb)
+    p[0] = -p[1:].sum()
+    return p
+
+
+def _angle_flows(grid, p):
+    """Direct DC solve: B theta = p with theta[0]=0, flows from angles."""
+    nb = len(grid.buses)
+    nl = len(grid.branch_b)
+    A = np.zeros((nl, nb))
+    rows = np.arange(nl)
+    A[rows, np.asarray(grid.branch_from, int)] = 1.0
+    A[rows, np.asarray(grid.branch_to, int)] = -1.0
+    Bd = np.asarray(grid.branch_b, float)[:, None] * A
+    Bbus = A.T @ Bd
+    theta = np.zeros(nb)
+    theta[1:] = np.linalg.solve(Bbus[1:, 1:], p[1:])
+    return Bd @ theta
+
+
+def _drop_branch(grid, li):
+    keep = np.arange(len(grid.branch_b)) != li
+    return dataclasses.replace(
+        grid,
+        branch_from=np.asarray(grid.branch_from)[keep],
+        branch_to=np.asarray(grid.branch_to)[keep],
+        branch_b=np.asarray(grid.branch_b)[keep],
+        branch_limit=np.asarray(grid.branch_limit)[keep],
+    )
+
+
+# ---------------------------------------------------------------------
+# PTDF / LODF host math
+# ---------------------------------------------------------------------
+class TestPtdfLodf:
+    def test_ptdf_matches_angle_solve(self, grid6):
+        p = _injections(grid6)
+        ptdf = ptdf_matrix(grid6)
+        assert np.allclose(ptdf[:, 0], 0.0)
+        np.testing.assert_allclose(
+            ptdf @ p, _angle_flows(grid6, p), atol=1e-10
+        )
+
+    def test_lodf_matches_outaged_network(self, grid6):
+        p = _injections(grid6)
+        ptdf = ptdf_matrix(grid6)
+        lodf, islanding = lodf_matrix(grid6, ptdf)
+        np.testing.assert_allclose(np.diag(lodf), -1.0)
+        f0 = ptdf @ p
+        live = [li for li in range(len(grid6.branch_b)) if not islanding[li]]
+        assert live, "ring+chord topology should have no bridges"
+        fpost = post_contingency_flows(f0, lodf, np.asarray(live, int))
+        for row, li in enumerate(live):
+            f_direct = ptdf_matrix(_drop_branch(grid6, li)) @ p
+            keep = np.arange(len(grid6.branch_b)) != li
+            np.testing.assert_allclose(
+                fpost[row][keep], f_direct, atol=1e-8,
+                err_msg=f"LODF projection wrong for outage {li}",
+            )
+            # self-column is -1: the outaged branch's own post-flow is 0
+            assert abs(fpost[row][li]) < 1e-8
+
+    def test_islanding_bridge_excluded(self):
+        # ring on buses 0..3 plus a pendant bus 4: branch 4 is a bridge
+        g = SimpleNamespace(
+            buses=[0, 1, 2, 3, 4],
+            branch_from=np.array([0, 1, 2, 3, 3]),
+            branch_to=np.array([1, 2, 3, 0, 4]),
+            branch_b=np.ones(5) * 10.0,
+            branch_limit=np.ones(5) * 100.0,
+        )
+        lodf, islanding = lodf_matrix(g)
+        assert bool(islanding[4]) and not islanding[:4].any()
+        assert np.allclose(lodf[:, 4], 0.0)
+        cset = ContingencySet.n_minus_1(g, gens=False)
+        assert 4 not in cset.branch_indices()
+        assert sorted(cset.branch_indices()) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------
+# the one-lowered contingency program
+# ---------------------------------------------------------------------
+class TestContingencyProgram:
+    def test_named_row_regions(self, grid6):
+        nb, nl = len(grid6.buses), len(grid6.branch_b)
+        prog = contingency_dcopf_program(grid6)
+        rr = prog.row_ranges
+        for name in ("base_commit", "flow_def", "ref_angle", "balance",
+                     "flow_cap_pos", "flow_cap_neg"):
+            assert name in rr, f"missing row region {name!r}"
+        assert rr["balance"][1] - rr["balance"][0] == nb
+        assert rr["flow_def"][1] - rr["flow_def"][0] == nl
+        assert rr["flow_cap_pos"][1] - rr["flow_cap_pos"][0] == nl
+        assert rr["flow_cap_neg"][1] - rr["flow_cap_neg"][0] == nl
+        assert prog.balance_row0 == rr["balance"][0]
+        # the base SCED program names its regions too (no hand-counted
+        # balance_row0 anywhere)
+        bprog = dcopf_program(grid6)
+        assert bprog.balance_row0 == bprog.row_ranges["balance"][0]
+
+    def test_params_stacking(self, grid6):
+        base = base_operating_point(grid6)
+        cset = ContingencySet.n_minus_1(grid6)
+        params = contingency_params(grid6, base, cset, rate_factor=1.2)
+        K, nl = cset.K, len(grid6.branch_b)
+        assert params["branch_on"].shape == (K, nl)
+        np.testing.assert_allclose(
+            params["branch_cap"],
+            np.tile(np.asarray(grid6.branch_limit) * 1.2, (K, 1)),
+        )
+        for k, c in enumerate(cset):
+            if c.kind == "branch":
+                assert params["branch_on"][k, c.index] == 0.0
+                assert params["branch_on"][k].sum() == nl - 1
+            else:
+                assert params["commit"][k, c.index] == 0.0
+
+    def test_batched_matches_outaged_serial(self, flex6):
+        """Each batched row's economics equal a from-scratch solve of the
+        physically modified system: branch outage vs the branch-removed
+        grid's own SCED, gen outage vs the commit-zeroed base SCED."""
+        grid, base = flex6
+        _, islanding = lodf_matrix(grid)
+        li = int(np.where(~islanding)[0][0])
+        gi = 1  # unit 0 carries most of the load; its outage sheds
+        cset = ContingencySet(
+            [Contingency("branch", li, f"branch:{li}"),
+             Contingency("gen", gi, f"gen:{gi}")]
+        )
+        prog = contingency_dcopf_program(grid)
+        screen = screen_contingencies(prog, grid, cset, base, **KW)
+        assert screen.converged.all()
+        # outaged branch's flow is pinned to zero by its own row
+        assert abs(screen.flows[0, li]) < 1e-8
+        gmod = _drop_branch(grid, li)
+        ref_b = solve_lp(dcopf_program(gmod).instantiate(base), **KW)
+        assert bool(ref_b.converged)
+        # different formulations (parametric cap rows vs variable
+        # bounds) each converged to IPM tolerance: economics agree to
+        # ~1e-5 relative, not bitwise
+        np.testing.assert_allclose(
+            screen.objective[0], float(ref_b.obj), rtol=1e-4
+        )
+        gpar = {k: np.array(v, float) for k, v in base.items()}
+        gpar["commit"][gi] = 0.0
+        ref_g = solve_lp(dcopf_program(grid).instantiate(gpar), **KW)
+        assert bool(ref_g.converged)
+        np.testing.assert_allclose(
+            screen.objective[1], float(ref_g.obj), rtol=1e-4
+        )
+
+
+# ---------------------------------------------------------------------
+# batched-contingency bitwise contract (one executable for the K batch)
+# ---------------------------------------------------------------------
+class TestBatchedBitwise:
+    def test_adaptive_bitwise_one_compile(self, grid6):
+        base = base_operating_point(grid6)
+        cset = ContingencySet.n_minus_1(grid6)
+        assert cset.K >= 8
+        prog = contingency_dcopf_program(grid6)
+        lp = stack_contingency_lp(
+            prog, contingency_params(grid6, base, cset)
+        )
+        from dispatches_tpu.runtime.adaptive import solve_lp_adaptive
+
+        ref = solve_lp_batch(lp, **KW)
+        stats = {}
+        out = solve_lp_adaptive(
+            lp, ladder_base=cset.K, chunk_iters=64, stats=stats, **KW
+        )
+        for name, a, b in zip(ref._fields, ref, out):
+            assert _biteq(a, b), f"field {name} differs bitwise"
+        # ladder_base=K + chunk_iters >= max_iter: one bucket, one chunk,
+        # ONE lowered executable for the whole K batch
+        assert stats["buckets"] == [cset.K]
+        assert stats["chunks"] == 1
+        assert stats["compile_misses"] == 1
+
+
+# ---------------------------------------------------------------------
+# secure_dispatch: CG loop + screener safeguard
+# ---------------------------------------------------------------------
+class _RecordingScreener:
+    """Duck screener returning a fixed mask; records outcome hooks."""
+
+    def __init__(self, mask):
+        self.mask = mask
+        self.accepts = 0
+        self.caught = 0
+
+    def screen(self, problem, cset):
+        return self.mask
+
+    def note_accept(self):
+        self.accepts += 1
+
+    def note_violation_fallback(self, n=1):
+        self.caught += n
+
+
+class TestSecureDispatch:
+    def test_screener_off_bitwise_identity(self, grid6):
+        """With no violations and screener=None the secure dispatch IS
+        the plain SCED — bitwise, not approximately."""
+        base = base_operating_point(grid6)
+        cset = ContingencySet.n_minus_1(grid6, gens=False)
+        sd = secure_dispatch(grid6, base, cset, **KW)
+        assert sd.rounds == 1 and not sd.cuts and not sd.screened
+        assert sd.feasible and sd.escaped_violations == 0
+        assert sd.violated_outages == ()
+        assert sd.shrink_ratio == 1.0
+        ref = solve_lp(dcopf_program(grid6).instantiate(base), **KW)
+        for name in ("x", "y", "obj"):
+            a = np.asarray(getattr(ref, name))
+            b = np.asarray(getattr(sd.sol, name))
+            assert a.tobytes() == b.tobytes(), f"{name} differs bitwise"
+        np.testing.assert_array_equal(
+            sd.lmp,
+            np.asarray(ref.y)[
+                sd.prog.balance_row0 : sd.prog.balance_row0 + sd.prog.n_bus
+            ],
+        )
+
+    def test_cg_converges_to_n1_feasible(self, tight8):
+        grid, params = tight8
+        cset = ContingencySet.n_minus_1(grid, gens=False)
+        sd = secure_dispatch(grid, params, cset, conformance=True, **KW)
+        assert bool(np.asarray(sd.sol.converged))
+        assert sd.violated_outages, "tightened grid should start insecure"
+        assert sd.cuts and sd.rounds >= 2
+        assert sd.feasible and sd.escaped_violations == 0
+        assert sd.conformance is not None and sd.conformance["ok"]
+        # the preventive cuts cost money: secured dispatch can't be
+        # cheaper than the unconstrained one
+        ref = solve_lp(dcopf_program(grid).instantiate(params), **KW)
+        assert float(sd.sol.obj) >= float(ref.obj) - ABS_TOL
+        # and the final base flows project clean over the full set
+        lodf, islanding = lodf_matrix(grid)
+        idx = np.asarray(
+            [i for i in cset.branch_indices() if not islanding[i]], int
+        )
+        fpost = post_contingency_flows(sd.flows, lodf, idx)
+        limits = np.asarray(grid.branch_limit, float)
+        bound = np.broadcast_to(
+            limits + 2 * np.maximum(1e-4 * limits, ABS_TOL), fpost.shape
+        )
+        mask = np.ones_like(fpost, bool)
+        mask[np.arange(len(idx)), idx] = False  # outaged branch itself
+        assert np.all(np.abs(fpost)[mask] <= bound[mask])
+
+    def test_blind_screener_cannot_escape_violations(self, tight8):
+        """Violation injection: a screener that predicts NOTHING critical
+        must be caught by the full-set verify and repaired by fallback —
+        the safeguard that keeps the screener out of the TCB."""
+        grid, params = tight8
+        cset = ContingencySet.n_minus_1(grid, gens=False)
+        nb_ctg = len(cset.branch_indices())
+        blind = _RecordingScreener(np.zeros(nb_ctg, bool))
+        before = obs_metrics.flat_values()
+        sd = secure_dispatch(grid, params, cset, screener=blind, **KW)
+        after = obs_metrics.flat_values()
+        assert blind.caught > 0, "vacuous probe: grid had no violations"
+        assert sd.screened and sd.screen_fallback
+        assert sd.feasible and sd.escaped_violations == 0
+        key = "screener_violation_fallback_total"
+        assert after.get(key, 0.0) > before.get(key, 0.0)
+        assert blind.accepts == 0
+        assert (after.get("screener_accept_total", 0.0)
+                == before.get("screener_accept_total", 0.0))
+
+    def test_oracle_screener_accepted(self, tight8):
+        """A screener that names the truly-critical outages shrinks the
+        loop and passes full-set verification first try."""
+        grid, params = tight8
+        cset = ContingencySet.n_minus_1(grid, gens=False)
+        truth = secure_dispatch(grid, params, cset, **KW).violated_outages
+        assert truth
+        mask = screen_targets(cset, truth) >= 0.5
+        oracle = _RecordingScreener(mask)
+        before = obs_metrics.flat_values()
+        sd = secure_dispatch(grid, params, cset, screener=oracle, **KW)
+        after = obs_metrics.flat_values()
+        assert sd.screened and not sd.screen_fallback
+        assert sd.feasible and sd.escaped_violations == 0
+        assert sd.shrink_ratio < 1.0
+        assert oracle.accepts == 1 and oracle.caught == 0
+        assert (after.get("screener_accept_total", 0.0)
+                == before.get("screener_accept_total", 0.0) + 1.0)
+
+    def test_screen_targets_order_and_kinds(self):
+        cset = ContingencySet([
+            Contingency("branch", 3, "branch:3"),
+            Contingency("gen", 0, "gen:a"),
+            Contingency("branch", 7, "branch:7"),
+            Contingency("branch", 1, "branch:1"),
+        ])
+        np.testing.assert_array_equal(
+            screen_targets(cset, (7, 1)), [0.0, 1.0, 1.0]
+        )
+
+
+# ---------------------------------------------------------------------
+# screener artifact: train/save/load round trip + refuse-to-load modes
+# ---------------------------------------------------------------------
+def _toy_dataset(feature_dim=6, target_dim=8, rows=24, family="f" * 64):
+    from dispatches_tpu.learn.dataset import WarmStartDataset
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1.0, 1.0, (rows, feature_dim))
+    Y = (X[:, :target_dim % feature_dim or 1].sum(1, keepdims=True)
+         > 0).astype(float)
+    Y = np.tile(Y, (1, target_dim))
+    Y[:, target_dim // 2:] = 0.0  # some never-critical outages
+    return WarmStartDataset(
+        X, Y, family=family, varying=SCREEN_VARYING,
+        targets=[("x", target_dim)], problem_type="LPData",
+    )
+
+
+@pytest.fixture(scope="module")
+def toy_model(tmp_path_factory):
+    model, metrics = train_screener_model(
+        _toy_dataset(), hidden=(8,), epochs=60, seed=0
+    )
+    path = model.save(
+        str(tmp_path_factory.mktemp("screener") / "toy.npz")
+    )
+    return model, metrics, path
+
+
+def _tamper(path, out, **manifest_overrides):
+    with np.load(path, allow_pickle=False) as dat:
+        payload = {k: dat[k] for k in dat.files}
+    man = json.loads(str(payload["__manifest__"]))
+    man.update(manifest_overrides)
+    payload["__manifest__"] = np.asarray(json.dumps(man))
+    np.savez(out, **payload)
+    return out
+
+
+class TestScreenerArtifact:
+    def test_round_trip(self, toy_model):
+        model, metrics, path = toy_model
+        assert model.manifest["kind"] == SCREENER_KIND
+        assert model.manifest["version"] == SCREENER_VERSION
+        assert model.threshold == DEFAULT_THRESHOLD
+        assert 0.0 <= metrics["train_recall"] <= 1.0
+        loaded = ScreenerModel.load(path, expect_family="f" * 64)
+        X = np.random.default_rng(1).uniform(-1, 1, (5, model.feature_dim))
+        np.testing.assert_array_equal(loaded.predict(X), model.predict(X))
+        mask = loaded.critical_mask(X)
+        assert mask.shape == (5, model.target_dim) and mask.dtype == bool
+
+    def test_refuse_wrong_version(self, toy_model, tmp_path):
+        _, _, path = toy_model
+        bad = _tamper(path, str(tmp_path / "v.npz"), version=999)
+        with pytest.raises(ArtifactMismatch, match="version"):
+            ScreenerModel.load(bad)
+
+    def test_refuse_wrong_kind(self, toy_model, tmp_path):
+        _, _, path = toy_model
+        bad = _tamper(path, str(tmp_path / "k.npz"), kind="lane_router")
+        with pytest.raises(ArtifactMismatch, match="kind"):
+            ScreenerModel.load(bad)
+
+    def test_refuse_family_mismatch(self, toy_model):
+        _, _, path = toy_model
+        with pytest.raises(ArtifactMismatch, match="family"):
+            ScreenerModel.load(path, expect_family="0" * 64)
+
+    def test_refuse_missing_scaling(self, toy_model, tmp_path):
+        _, _, path = toy_model
+        with np.load(path, allow_pickle=False) as dat:
+            payload = {
+                k: dat[k] for k in dat.files if k != "scale/xm_inputs"
+            }
+        bad = str(tmp_path / "m.npz")
+        np.savez(bad, **payload)
+        with pytest.raises(ArtifactMismatch, match="missing"):
+            ScreenerModel.load(bad)
+
+    def test_refuse_not_an_artifact(self, tmp_path):
+        p = str(tmp_path / "junk.npz")
+        np.savez(p, a=np.zeros(3))
+        with pytest.raises(ArtifactMismatch, match="not a screener"):
+            ScreenerModel.load(p)
+
+    def test_as_screener_coercion(self, toy_model):
+        _, _, path = toy_model
+        assert as_screener(None) is None
+        s = as_screener(path)
+        assert isinstance(s, ContingencyScreener)
+        assert as_screener(s) is s
+        assert s.families == ("f" * 64,)
+        assert as_screener([path]).families == s.families
+
+
+# ---------------------------------------------------------------------
+# serve-side screen(): never raises, every fallback counted
+# ---------------------------------------------------------------------
+class TestScreenerServe:
+    def _delta(self, before, after, reason):
+        key = f'screener_fallback_total{{reason="{reason}"}}'
+        return after.get(key, 0.0) - before.get(key, 0.0)
+
+    def test_unseen_family_falls_back(self, grid6):
+        base = base_operating_point(grid6)
+        lp = dcopf_program(grid6).instantiate(base)
+        cset = ContingencySet.n_minus_1(grid6, gens=False)
+        s = ContingencyScreener()
+        before = obs_metrics.flat_values()
+        assert s.screen(lp, cset) is None
+        assert self._delta(
+            before, obs_metrics.flat_values(), "unseen_family") == 1.0
+
+    def test_matched_family_screens(self, grid6):
+        from dispatches_tpu.learn.dataset import (
+            family_fingerprint, features_of,
+        )
+
+        base = base_operating_point(grid6)
+        lp = dcopf_program(grid6).instantiate(base)
+        cset = ContingencySet.n_minus_1(grid6, gens=False)
+        fam = family_fingerprint(lp, SCREEN_VARYING)
+        feats = features_of(lp, SCREEN_VARYING)
+        model, _ = train_screener_model(
+            _toy_dataset(
+                feature_dim=int(feats.size),
+                target_dim=len(cset.branch_indices()),
+                family=fam,
+            ),
+            hidden=(8,), epochs=30,
+        )
+        s = ContingencyScreener([model])
+        before = obs_metrics.flat_values()
+        mask = s.screen(lp, cset)
+        after = obs_metrics.flat_values()
+        assert mask is not None and mask.dtype == bool
+        assert mask.shape == (len(cset.branch_indices()),)
+        assert (after.get("screener_screen_total", 0.0)
+                == before.get("screener_screen_total", 0.0) + 1.0)
+
+        # ctg_mismatch: same family, differently sized contingency set
+        smaller = ContingencySet(cset.contingencies[:-1])
+        before = obs_metrics.flat_values()
+        assert s.screen(lp, smaller) is None
+        assert self._delta(
+            before, obs_metrics.flat_values(), "ctg_mismatch") == 1.0
+
+        # feature_mismatch: manifest disagrees with the live problem
+        model.manifest["feature_dim"] = int(feats.size) + 1
+        before = obs_metrics.flat_values()
+        assert s.screen(lp, cset) is None
+        assert self._delta(
+            before, obs_metrics.flat_values(), "feature_mismatch") == 1.0
+        model.manifest["feature_dim"] = int(feats.size)
+
+        # a predictor blowing up must not kill the dispatch
+        def boom(X):
+            raise RuntimeError("synthetic predictor failure")
+
+        model.predict = boom
+        before = obs_metrics.flat_values()
+        assert s.screen(lp, cset) is None
+        assert self._delta(
+            before, obs_metrics.flat_values(), "error") == 1.0
+
+    def test_secure_dispatch_path_coercion(self, grid6, toy_model):
+        """secure_dispatch(screener=<path>) loads the artifact itself;
+        the toy family never matches a real grid, so the dispatch runs
+        unscreened (counted) but still to a feasible result."""
+        _, _, path = toy_model
+        base = base_operating_point(grid6)
+        cset = ContingencySet.n_minus_1(grid6, gens=False)
+        before = obs_metrics.flat_values()
+        sd = secure_dispatch(grid6, base, cset, screener=path, **KW)
+        assert not sd.screened and sd.feasible
+        assert self._delta(
+            before, obs_metrics.flat_values(), "unseen_family") == 1.0
+
+
+# ---------------------------------------------------------------------
+# trace_summary: ctg column + contingency footer, pre-v8 neutrality
+# ---------------------------------------------------------------------
+def _base_journal():
+    return [
+        {"kind": "manifest", "run_id": "r1", "schema_version": 4,
+         "git_sha": "cafe", "device_kind": "cpu", "device_count": 1},
+        {"kind": "span_start", "span": "solve", "ts": 0.0, "mono": 0.0},
+        {"kind": "span_end", "span": "solve", "ok": True, "wall_s": 0.5},
+    ]
+
+
+def _solve_record(**extra):
+    rec = {"kind": "solve", "name": "solve_lp", "span": "solve",
+           "stats": {"batch": 1, "converged_frac": 1.0,
+                     "iterations": {"min": 5, "max": 5, "median": 5}}}
+    rec.update(extra)
+    return rec
+
+
+def _render(tmp_path, records):
+    ts = importlib.import_module("tools.trace_summary")
+    p = tmp_path / "j.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    out = io.StringIO()
+    rc = ts.main([str(p)], out=out)
+    return rc, out.getvalue()
+
+
+class TestTraceSummaryContingency:
+    def test_pre_v8_renders_without_ctg_surface(self, tmp_path):
+        rc, txt = _render(tmp_path, _base_journal() + [_solve_record()])
+        assert rc == 0
+        assert " ctg=" not in txt
+        assert "contingency" not in txt and "ctg screen" not in txt
+
+    def test_ctg_column_and_footer(self, tmp_path):
+        recs = _base_journal() + [
+            _solve_record(name="contingency_screen", ctg="screen[K=40]"),
+            _solve_record(name="secure_dispatch", ctg="screened"),
+            {"kind": "event", "name": "contingency_event", "span": "solve",
+             "phase": "screen", "K": 40, "critical": 7,
+             "shed_contingencies": 2, "converged": 40},
+            {"kind": "event", "name": "contingency_event", "span": "solve",
+             "phase": "round", "round": 1, "evaluated": 9, "K": 40,
+             "violations": 3, "cuts_added": 3, "cuts_total": 3,
+             "screened": True},
+            {"kind": "event", "name": "contingency_event", "span": "solve",
+             "phase": "final", "K": 40, "rounds": 2, "cuts_total": 3,
+             "feasible": True, "escaped": 0, "screened": True,
+             "screen_fallback": False, "shrink": 0.225},
+        ]
+        rc, txt = _render(tmp_path, recs)
+        assert rc == 0
+        assert " ctg=screen[K=40]" in txt
+        assert " ctg=screened" in txt
+        assert "ctg screen: K=40 converged=40/40 critical=7" in txt
+        assert ("contingency: K=40 rounds=2 cuts=3 feasible "
+                "screened shrink=0.23") in txt
+
+    def test_footer_flags_escapes_and_fallback(self, tmp_path):
+        recs = _base_journal() + [
+            {"kind": "event", "name": "contingency_event", "span": "solve",
+             "phase": "final", "K": 12, "rounds": 10, "cuts_total": 8,
+             "feasible": False, "escaped": 2, "screened": True,
+             "screen_fallback": True, "shrink": 0.5},
+        ]
+        rc, txt = _render(tmp_path, recs)
+        assert rc == 0
+        assert "INFEASIBLE" in txt and "ESCAPED=2" in txt
+        assert "fallback" in txt

@@ -276,8 +276,10 @@ class TestCostModel:
 class TestJournalV2:
     def test_manifest_schema_version_and_mono(self):
         tracer = Tracer(None)
-        # v6: lane_decision/lane_probe records (obs.lanes)
-        assert tracer.manifest["schema_version"] == 6
+        # v8: contingency_event records + ctg= solve attrs
+        # (market/contingency.py); v7 added batch_stats restart columns,
+        # v6 the lane_decision/lane_probe records (obs.lanes)
+        assert tracer.manifest["schema_version"] == 8
         assert tracer.manifest["clock"] == "perf_counter"
         with tracer.span("a"):
             pass

@@ -99,6 +99,16 @@ _HIGHER_IS_BETTER = (
     # lower-is-better: a fallback storm appearing (unseen families,
     # feature mismatches, predict errors) is the artifact aging out.
     "lane_model_route_total",
+    # contingency screening (market/contingency.py + learn/screener.py):
+    # the screener ACCEPTING a screened solve (full-set verification
+    # found no escaped violation) and the model screening at all are the
+    # plane working — the bad direction is those counts dropping on a
+    # same workload. contingency_violations_total,
+    # screener_violation_fallback_total and the
+    # screener_fallback_total{reason=} family all fall through to
+    # lower-is-better: post-contingency violations appearing, or the
+    # screened path ceding back to the full set, is the bad direction.
+    "screener_accept", "screener_screen_total",
 )
 
 # metrics zero-seeded on whichever side lacks them (see compare()).
@@ -197,6 +207,23 @@ _ZERO_SEEDED = (
     # silently ceding every decision back to the scoreboards — never on
     # the model plane being switched on against a policy-off baseline.
     "lane_model_fallback_total", "lane_model_route_total",
+    # N-1 contingency SCED (market/contingency.py + learn/screener.py):
+    # escaped violations are the safeguard's hard invariant — a screened
+    # solve whose full-set verification found a violation the screener
+    # missed AND the fallback failed to repair. A clean baseline has no
+    # such series, so seeding makes even one escape appearing in NEW a
+    # gated regression. Violations/fallbacks seed and gate from zero
+    # too (the grid got less secure, or the screener artifact aged out
+    # of its traffic); accepts and screen counts seed but, as
+    # higher-is-better, only gate on a same-workload DROP (the screened
+    # path silently ceding every solve back to the full set).
+    # contingency_screen_solves_total / _rounds_total / _cuts_total are
+    # deliberately NOT here: they scale with K and with how insecure
+    # the base dispatch starts, so a screen-on run against a screen-off
+    # baseline must not trip the gate.
+    "contingency_escaped_violations_total", "contingency_violations_total",
+    "screener_accept_total", "screener_violation_fallback_total",
+    "screener_screen_total", "screener_fallback_total",
 )
 
 
@@ -1299,6 +1326,66 @@ def self_check(out=sys.stdout) -> int:
     })
     checks.append((
         "fallbacks vs policy-off baseline still fail (zero-seeded)",
+        True, any(r["regression"] for r in rows)))
+
+    # N-1 contingency screening (market/contingency.py +
+    # learn/screener.py): escaped violations gate lower-is-better and
+    # from zero (the safeguard's hard invariant), screener fallbacks
+    # gate from zero (the artifact aging out), accepts gate only on a
+    # same-workload drop, and the screen volume counters never gate a
+    # screen-on run against a screen-off baseline
+    cbase = {
+        "metric/contingency_violations_total": 4.0,
+        "metric/contingency_escaped_violations_total": 0.0,
+        'metric/screener_accept_total{entry="secure_dispatch"}': 12.0,
+        'metric/screener_violation_fallback_total{entry="secure_dispatch"}':
+        0.0,
+        'metric/screener_fallback_total{reason="unseen_family"}': 0.0,
+        "serve/loadgen/goodput_rps": 120.0,
+    }
+
+    def crun(name: str, new: Dict[str, float], expect: bool) -> None:
+        rows = compare(cbase, new)
+        checks.append((name, expect, any(r["regression"] for r in rows)))
+
+    crun("identical contingency metrics pass", dict(cbase), False)
+    crun("escaped violations appearing from zero fail (safeguard breached)",
+         {**cbase, "metric/contingency_escaped_violations_total": 1.0},
+         True)
+    crun("post-contingency violations doubling fail (grid less secure)",
+         {**cbase, "metric/contingency_violations_total": 8.0}, True)
+    crun("violation fallbacks appearing from zero fail (screener missing "
+         "criticals)",
+         {**cbase,
+          'metric/screener_violation_fallback_total{entry="secure_dispatch"}':
+          3.0}, True)
+    crun("screener accepts dropping >10% fail (screened path ceded back)",
+         {**cbase,
+          'metric/screener_accept_total{entry="secure_dispatch"}': 4.0},
+         True)
+    crun("screener accepts growing pass (higher is better)",
+         {**cbase,
+          'metric/screener_accept_total{entry="secure_dispatch"}': 24.0},
+         False)
+    cleanc = {"serve/loadgen/goodput_rps": 120.0}
+    rows = compare(cleanc, {
+        **cleanc,
+        'metric/screener_accept_total{entry="secure_dispatch"}': 12.0,
+        "metric/contingency_screen_solves_total": 96.0,
+        "metric/contingency_cuts_total": 5.0,
+        "metric/contingency_escaped_violations_total": 0.0,
+    })
+    checks.append((
+        "screen-on run vs screen-off baseline passes (volume counters "
+        "not zero-seeded, accepts higher-is-better, zero escapes)",
+        False, any(r["regression"] for r in rows)))
+    rows = compare(cleanc, {
+        **cleanc,
+        'metric/screener_fallback_total{reason="ctg_mismatch"}': 2.0,
+    })
+    checks.append((
+        "screener fallbacks vs screen-off baseline still fail "
+        "(zero-seeded evidence of an aged-out artifact)",
         True, any(r["regression"] for r in rows)))
 
     ok = True

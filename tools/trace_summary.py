@@ -46,6 +46,14 @@ manifest record). For each run this prints:
   batch_stats carry a restart count and first->final step-size columns
   (with the recorded change count) on trace sub-lines — pre-v7 journals
   and control-off runs render exactly as before;
+- when the run holds schema-v8 contingency records
+  (market/contingency.py), a ``ctg=`` column on solve lines that carry
+  the contingency attr (the batched N-1 screen vs the screened/full
+  secure-dispatch path) and a contingency footer: screen summaries
+  (K, converged, critical outages) plus one line per secure dispatch
+  (K, rounds to feasible, cuts, screened shrink ratio, any escaped
+  violations) from ``contingency_event`` records — pre-v8 journals and
+  contingency-off runs render exactly as before;
 - cumulative retrace counts from the close record (or summed span deltas
   for a run that died before closing).
 
@@ -329,6 +337,12 @@ def _print_one_solve(name: str, ev: dict, out, journeys=None) -> None:
     # as before.
     if ev.get("lane"):
         line += f" lane={ev['lane']}"
+    # schema-v8 contingency attr (market/contingency.py): which N-1
+    # evaluation produced the solve — the batched screen or the
+    # screened/full constraint-generation path. Journals predating the
+    # subsystem render exactly as before.
+    if ev.get("ctg"):
+        line += f" ctg={ev['ctg']}"
     # serve-layer columns (dispatches_tpu/serve): per-request solves
     if ev.get("request_id") is not None:
         line += f" req={ev['request_id']}"
@@ -579,6 +593,53 @@ def _print_lanes_footer(run: List[dict], out) -> None:
         print(f"  lanes {fam[:12]}: {' '.join(bits)}", file=out)
 
 
+def _print_contingency_footer(run: List[dict], out) -> None:
+    """N-1 contingency ledger from schema-v8 ``contingency_event``
+    records: one line per corrective screen (K, converged, critical
+    outages) and one per secure dispatch final summary (rounds to
+    feasible, cuts, screened shrink, escaped violations). Silent for
+    pre-v8 journals and contingency-off runs — no events, no footer."""
+    screens = []
+    finals = []
+    for ev in run:
+        if ev.get("kind") != "event" or ev.get("name") != "contingency_event":
+            continue
+        ph = ev.get("phase")
+        if ph == "screen":
+            screens.append(ev)
+        elif ph == "final":
+            finals.append(ev)
+    if not screens and not finals:
+        return
+    for ev in screens:
+        k = ev.get("K")
+        print(
+            f"  ctg screen: K={k} converged={ev.get('converged')}/{k}"
+            f" critical={ev.get('critical')}"
+            f" shed_ctgs={ev.get('shed_contingencies')}",
+            file=out,
+        )
+    for ev in finals:
+        bits = [
+            f"K={ev.get('K')}",
+            f"rounds={ev.get('rounds')}",
+            f"cuts={ev.get('cuts_total')}",
+            "feasible" if ev.get("feasible") else "INFEASIBLE",
+        ]
+        if ev.get("escaped"):
+            bits.append(f"ESCAPED={ev['escaped']}")
+        if ev.get("screened"):
+            shrink = ev.get("shrink")
+            bits.append(
+                f"screened shrink={shrink:.2f}"
+                if isinstance(shrink, (int, float))
+                else "screened"
+            )
+            if ev.get("screen_fallback"):
+                bits.append("fallback")
+        print(f"  contingency: {' '.join(bits)}", file=out)
+
+
 def _print_journeys_footer(run: List[dict], out) -> None:
     """Run-level journey aggregate: terminal counts, cross-process
     lineage, and per-priority queue-wait / compute p95s (nearest rank).
@@ -779,6 +840,7 @@ def _print_run(run: List[dict], out, max_spans: int) -> None:
     _print_health_footer(run, out)
     _print_conformance_footer(run, out)
     _print_lanes_footer(run, out)
+    _print_contingency_footer(run, out)
     _print_warm_footer(run, out)
     _print_journeys_footer(run, out)
     _print_compile_footer(run, out)
